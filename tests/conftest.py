@@ -1,0 +1,52 @@
+"""Test harness: a virtual 8-device CPU mesh in one process.
+
+Mirrors the reference's fake/meta-pg strategy (legacy/test/common_dtensor.py)
+— "multi-node is never required"; all distributed logic is exercised on
+simulated devices.  Must run before jax initializes its backends.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override env (e.g. axon/TPU) for tests
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The axon sitecustomize imports jax at interpreter startup and pins
+# jax_platforms; force CPU here (backends init lazily, so this still wins).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+assert len(jax.devices()) >= 8, "virtual 8-device CPU mesh not available"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from vescale_tpu.mesh import DeviceMesh  # noqa: E402
+
+NUM_DEVICES = 8
+
+
+@pytest.fixture
+def mesh1d():
+    return DeviceMesh(("tp",), (8,))
+
+
+@pytest.fixture
+def mesh2d():
+    return DeviceMesh(("dp", "tp"), (2, 4))
+
+
+@pytest.fixture
+def mesh4d():
+    return DeviceMesh(("pp", "dp", "sp", "tp"), (2, 2, 1, 2))
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    from vescale_tpu.random import manual_seed
+
+    manual_seed(0)
+    yield

@@ -1,0 +1,206 @@
+"""DArray construction / views / redistribute tests (mirrors reference
+legacy/test/dtensor/general/test_api.py + comm/test_redistribute.py)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import vescale_tpu as vt
+from vescale_tpu.placements import InterleavedShard, Partial, RaggedShard, Replicate, Shard
+
+
+def test_distribute_and_full_tensor(mesh2d):
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    d = vt.distribute_tensor(x, mesh2d, [Shard(0), Shard(1)])
+    assert d.shape == (8, 8)
+    np.testing.assert_array_equal(np.asarray(d.full_tensor()), x)
+    # local view of rank (1,2) -> rows 4:8, cols 4:6
+    loc = d.to_local(rank=1 * 4 + 2)
+    np.testing.assert_array_equal(np.asarray(loc), x[4:8, 4:6])
+
+
+def test_distribute_replicate(mesh2d):
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    d = vt.distribute_tensor(x, mesh2d)  # all-replicate
+    np.testing.assert_array_equal(np.asarray(d.to_local(rank=5)), x)
+
+
+def test_uneven_shard(mesh1d):
+    x = np.arange(10, dtype=np.float32)
+    d = vt.distribute_tensor(x, mesh1d, [Shard(0)])
+    np.testing.assert_array_equal(np.asarray(d.full_tensor()), x)
+    assert d.to_local(rank=0).shape == (2,)
+    assert d.to_local(rank=7).shape == (0,)  # ceil chunks of 10/8 = 2 -> last empty
+
+
+def test_from_local_shard(mesh2d):
+    # 8 ranks in 2x4: shard dim0 over dp, dim1 over tp
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    locals_ = []
+    for r in range(8):
+        dp, tp = np.unravel_index(r, (2, 4))
+        locals_.append(x[dp * 4:(dp + 1) * 4, tp * 2:(tp + 1) * 2])
+    d = vt.from_local(locals_, mesh2d, [Shard(0), Shard(1)])
+    assert d.shape == (8, 8)
+    np.testing.assert_array_equal(np.asarray(d.full_tensor()), x)
+
+
+def test_from_local_partial(mesh1d):
+    locals_ = [np.full((2, 2), float(r)) for r in range(8)]
+    d = vt.from_local(locals_, mesh1d, [Partial()])
+    np.testing.assert_array_equal(np.asarray(d.full_tensor()), np.full((2, 2), sum(range(8))))
+    np.testing.assert_array_equal(np.asarray(d.to_local(rank=3)), np.full((2, 2), 3.0))
+
+
+def test_from_local_single_spmd(mesh1d):
+    loc = np.ones((2, 3), np.float32)
+    d = vt.from_local(loc, mesh1d, [Shard(0)])
+    assert d.shape == (16, 3)
+
+
+def test_redistribute_shard_to_replicate(mesh1d):
+    x = np.arange(16, dtype=np.float32).reshape(16, 1)
+    d = vt.distribute_tensor(x, mesh1d, [Shard(0)])
+    r = d.redistribute(placements=[Replicate()])
+    assert r.placements == (Replicate(),)
+    np.testing.assert_array_equal(np.asarray(r.to_local(rank=6)), x)
+
+
+def test_redistribute_partial_to_replicate(mesh1d):
+    locals_ = [np.full((4,), 1.0, np.float32)] * 8
+    d = vt.from_local(locals_, mesh1d, [Partial()])
+    r = d.redistribute(placements=[Replicate()])
+    np.testing.assert_array_equal(np.asarray(r.to_local()), np.full((4,), 8.0))
+
+
+def test_redistribute_partial_to_shard(mesh1d):
+    locals_ = [np.arange(8, dtype=np.float32)] * 8
+    d = vt.from_local(locals_, mesh1d, [Partial()])
+    r = d.redistribute(placements=[Shard(0)])
+    np.testing.assert_array_equal(np.asarray(r.to_local(rank=2)), np.array([16.0]))
+
+
+def test_redistribute_shard_to_shard(mesh2d):
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    d = vt.distribute_tensor(x, mesh2d, [Replicate(), Shard(0)])
+    r = d.redistribute(placements=[Replicate(), Shard(1)])
+    np.testing.assert_array_equal(np.asarray(r.full_tensor()), x)
+    np.testing.assert_array_equal(np.asarray(r.to_local(rank=3)), x[:, 6:8])
+
+
+def test_redistribute_ragged_allgather_v():
+    mesh = vt.DeviceMesh(("fsdp",), (4,))
+    x = np.arange(16, dtype=np.float32)
+    rp = RaggedShard((0,), (1, 2, 3, 2))
+    d = vt.distribute_tensor(x, mesh, [rp])
+    assert d.to_local(rank=2).shape == (6,)
+    r = d.redistribute(placements=[Replicate()])
+    np.testing.assert_array_equal(np.asarray(r.to_local()), x)
+
+
+def test_redistribute_ragged_to_ragged_all_to_all_v():
+    mesh = vt.DeviceMesh(("fsdp",), (4,))
+    x = np.arange(16, dtype=np.float32)
+    d = vt.distribute_tensor(x, mesh, [RaggedShard((0,), (1, 2, 3, 2))])
+    r = d.redistribute(placements=[RaggedShard((0,), (2, 2, 2, 2))])
+    np.testing.assert_array_equal(np.asarray(r.full_tensor()), x)
+    np.testing.assert_array_equal(np.asarray(r.to_local(rank=1)), x[4:8])
+
+
+def test_interleaved_shard_local(mesh1d):
+    mesh = vt.DeviceMesh(("tp",), (4,))
+    x = np.arange(24, dtype=np.float32)
+    d = vt.distribute_tensor(x, mesh, [InterleavedShard(0, 3)])
+    # rank 1 owns chunk 1 of each of 3 sections of 8: [2:4], [10:12], [18:20]
+    np.testing.assert_array_equal(np.asarray(d.to_local(rank=1)), x[[2, 3, 10, 11, 18, 19]])
+    r = d.redistribute(placements=[Replicate()])
+    np.testing.assert_array_equal(np.asarray(r.to_local()), x)
+
+
+def test_darray_through_jit(mesh2d):
+    x = np.ones((8, 4), np.float32)
+    d = vt.distribute_tensor(x, mesh2d, [Shard(0), Replicate()])
+
+    @jax.jit
+    def f(a: vt.DArray):
+        return vt.DArray(a.data * 2.0, a.spec)
+
+    out = f(d)
+    assert isinstance(out, vt.DArray)
+    np.testing.assert_array_equal(np.asarray(out.full_tensor()), x * 2)
+
+
+def test_elementwise_ops(mesh1d):
+    a = vt.distribute_tensor(np.ones((8,), np.float32), mesh1d, [Shard(0)])
+    b = vt.distribute_tensor(np.full((8,), 2.0, np.float32), mesh1d, [Shard(0)])
+    c = a + b * 2.0
+    np.testing.assert_array_equal(np.asarray(c.full_tensor()), np.full((8,), 5.0))
+    with pytest.raises(ValueError):
+        rep = b.redistribute(placements=[Replicate()])
+        _ = a + rep  # mismatched placements
+
+
+def test_factories(mesh2d):
+    z = vt.zeros((4, 4), device_mesh=mesh2d, placements=[Shard(0)])
+    assert z.shape == (4, 4) and float(jnp.sum(z.full_tensor())) == 0.0
+    o = vt.ones((4, 4), device_mesh=mesh2d, placements=[Replicate(), Shard(1)])
+    assert float(jnp.sum(o.full_tensor())) == 16.0
+    r = vt.randn((16, 8), device_mesh=mesh2d, placements=[Shard(0), Shard(1)])
+    # bitwise single-device-equality: same seed, unsharded
+    vt.manual_seed(0)
+    r2 = vt.randn((16, 8), device_mesh=mesh2d, placements=None)
+    np.testing.assert_array_equal(np.asarray(r.full_tensor()), np.asarray(r2.full_tensor()))
+    a = vt.arange(10, device_mesh=mesh2d, placements=[Shard(0)])
+    np.testing.assert_array_equal(np.asarray(a.full_tensor()), np.arange(10))
+
+
+def test_collective_api(mesh2d):
+    x = np.arange(16, dtype=np.float32).reshape(8, 2)
+    d = vt.distribute_tensor(x, mesh2d, [Replicate(), Shard(0)])
+    g = vt.vescale_all_gather(d, mesh_dims=["tp"])
+    assert g.placements == (Replicate(), Replicate())
+    locals_ = [x] * 8
+    p = vt.from_local(locals_, mesh2d, [Partial(), Partial()])
+    s = vt.vescale_all_reduce(p, mesh_dims=["dp"])
+    assert s.placements[0].is_replicate() and s.placements[1].is_partial()
+    np.testing.assert_array_equal(np.asarray(s.full_tensor()), x * 8)
+
+
+def test_uneven_redistribute_no_padded_leak(mesh1d):
+    # regression: fast path must not reattach the padded physical buffer
+    x = np.arange(10, dtype=np.float32)
+    d = vt.distribute_tensor(x, mesh1d, [Shard(0)])
+    r = d.redistribute(placements=[Replicate()])
+    assert r.shape == (10,)
+    np.testing.assert_array_equal(np.asarray(r.to_local()), x)
+
+
+def test_double_interleaved_roundtrip():
+    # regression: unpack with two InterleavedShard dims
+    mesh = vt.DeviceMesh(("a", "b"), (2, 2))
+    x = np.arange(32, dtype=np.float32).reshape(4, 8)
+    d = vt.distribute_tensor(x, mesh, [InterleavedShard(0, 2), InterleavedShard(1, 2)])
+    np.testing.assert_array_equal(np.asarray(d.full_tensor()), x)
+
+
+def test_partial_maxmin_guards(mesh1d):
+    d = vt.from_local([np.array([1.0, 5.0]), np.array([3.0, 2.0])] * 4, mesh1d, [Partial("max")])
+    with pytest.raises(ValueError):
+        -d
+    with pytest.raises(ValueError):
+        d * -2.0
+    np.testing.assert_array_equal(np.asarray(d.full_tensor()), np.array([3.0, 5.0]))
+
+
+def test_redistribute_local_tensor_guard(mesh1d):
+    from vescale_tpu.spec import DArraySpec, TensorMeta
+    import jax.numpy as jnp
+
+    src = DArraySpec(mesh1d, [Shard(0)], TensorMeta((16,), jnp.float32))
+    dst = DArraySpec(mesh1d, [Replicate()], TensorMeta((16,), jnp.float32))
+    with pytest.raises(ValueError):
+        vt.redistribute_local_tensor(np.arange(2, dtype=np.float32), src, dst)
+    locals_ = [np.arange(r * 2, r * 2 + 2, dtype=np.float32) for r in range(8)]
+    out = vt.redistribute_local_tensor(locals_, src, dst)
+    np.testing.assert_array_equal(np.asarray(out), np.arange(16, dtype=np.float32))

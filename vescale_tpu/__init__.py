@@ -1,0 +1,43 @@
+"""vescale_tpu — a TPU-native SPMD LLM-training framework with the
+capabilities of veScale (volcengine/veScale), built on JAX/XLA/pjit/Pallas.
+
+Everything is exported flat, mirroring the reference's
+legacy/vescale/__init__.py:41-76.
+"""
+
+__version__ = "0.1.0"
+
+from .placements import (
+    Placement,
+    Shard,
+    Replicate,
+    Partial,
+    InterleavedShard,
+    RaggedShard,
+    StridedRaggedShard,
+    normalize_placements,
+)
+from .spec import DArraySpec, TensorMeta
+from .mesh import DeviceMesh, init_device_mesh
+from .darray import (
+    DArray,
+    from_local,
+    distribute_tensor,
+    redistribute_dtensor,
+    full_tensor,
+    zeros,
+    ones,
+    empty,
+    full,
+    randn,
+    rand,
+    arange,
+)
+from .redistribute import redistribute, redistribute_local_tensor
+from .api import vescale_all_gather, vescale_all_reduce, vescale_reduce_scatter
+from .random import manual_seed, get_rng_tracker
+from . import collectives
+
+# DTensor-compatible aliases for migration from the reference API
+DTensor = DArray
+DTensorSpec = DArraySpec

@@ -1,0 +1,425 @@
+"""DArray — the distributed array (DTensor equivalent).
+
+Capability parity with the reference DTensor
+(legacy/vescale/dtensor/dtensor.py:268, api.py:39-388) with a TPU-native
+twist: a DArray *is* a global ``jax.Array`` (already a distributed value in
+JAX) plus a ``DArraySpec`` describing the veScale-style placements.  There is
+no per-op ``__torch_dispatch__`` — inside ``jax.jit`` the spec lowers to GSPMD
+sharding constraints and XLA propagates shardings at trace time (SURVEY §3.2:
+"dispatch happens at trace time, not per-step").
+
+DArray is a pytree, so it flows through ``jit`` / ``grad`` / ``shard_map``
+unchanged; its data leaf is the *physical* array (see spec.py for the
+physical-layout algebra covering Partial stacking, interleaved reshapes and
+ragged padding).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .mesh import DeviceMesh
+from .placements import (
+    InterleavedShard,
+    Partial,
+    Placement,
+    RaggedShard,
+    Replicate,
+    Shard,
+    normalize_placements,
+)
+from .spec import DArraySpec, TensorMeta
+
+__all__ = [
+    "DArray",
+    "from_local",
+    "distribute_tensor",
+    "redistribute_dtensor",
+    "full_tensor",
+    "zeros",
+    "ones",
+    "empty",
+    "full",
+    "randn",
+    "rand",
+    "arange",
+]
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _apply_sharding(physical, spec: DArraySpec):
+    """Attach the spec's sharding: eager -> device_put, traced -> GSPMD
+    constraint (the one place the reference issued NCCL scatter/allgather)."""
+    if _is_traced(physical):
+        return jax.lax.with_sharding_constraint(physical, spec.named_sharding())
+    return jax.device_put(physical, spec.named_sharding())
+
+
+@jax.tree_util.register_pytree_node_class
+class DArray:
+    """Global distributed array with veScale placements."""
+
+    __slots__ = ("_data", "_spec")
+
+    def __init__(self, data, spec: DArraySpec):
+        self._data = data
+        self._spec = spec
+
+    # pytree protocol — data is the leaf, spec is static
+    def tree_flatten(self):
+        return (self._data,), self._spec
+
+    @classmethod
+    def tree_unflatten(cls, spec, children):
+        return cls(children[0], spec)
+
+    # ----------------------------------------------------------- metadata
+    @property
+    def spec(self) -> DArraySpec:
+        return self._spec
+
+    @property
+    def mesh(self) -> DeviceMesh:
+        return self._spec.mesh
+
+    @property
+    def placements(self) -> Tuple[Placement, ...]:
+        return self._spec.placements
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._spec.shape
+
+    @property
+    def ndim(self) -> int:
+        return len(self._spec.shape)
+
+    @property
+    def dtype(self):
+        return self._spec.dtype
+
+    @property
+    def data(self):
+        """The physical global jax.Array (sharded per spec)."""
+        return self._data
+
+    def __repr__(self) -> str:
+        return f"DArray(shape={self.shape}, dtype={self.dtype}, spec={self._spec})"
+
+    # ------------------------------------------------------------- views
+    def to_local(self, rank: Optional[int] = None):
+        """This rank's local tensor (reference DTensor.to_local).  In the
+        single-controller model, ``rank`` selects the mesh flat rank
+        (default 0 — the canonical local view used by tests/checkpoint)."""
+        coord = self.mesh.coordinate_of_rank(rank or 0)
+        return _local_view(self._data, self._spec, coord)
+
+    def full_tensor(self):
+        """Reduce partials / gather shards into the logical global value
+        (reference api full_tensor / _to_replicate)."""
+        return self._spec.unpack(self._data)
+
+    def redistribute(
+        self,
+        mesh: Optional[DeviceMesh] = None,
+        placements=None,
+        async_op: bool = False,
+    ) -> "DArray":
+        from .redistribute import redistribute as _redis
+
+        return _redis(self, placements, mesh=mesh)
+
+    # ------------------------------------------------------ arithmetic
+    # A curated eager op set for same-spec elementwise math.  Anything more
+    # belongs in jit-traced model code where GSPMD handles layouts.
+    def _partial_ops(self):
+        return [p.reduce_op for p in self.placements if p.is_partial()]
+
+    def _elementwise(self, other, op, reverse=False):
+        partial_ops = self._partial_ops()
+        if isinstance(other, DArray):
+            if other._spec.placements != self._spec.placements or other.mesh != self.mesh:
+                raise ValueError(
+                    f"eager elementwise op requires matching placements; "
+                    f"got {self.placements} vs {other.placements} — redistribute first"
+                )
+            if partial_ops and (op is not jnp.add or any(o not in ("sum",) for o in partial_ops)):
+                raise ValueError("only + over Partial(sum) operands is linear")
+            a, b = self._data, other._data
+        else:
+            # scalar: only * on Partial(sum/avg) commutes with the reduction
+            # (and for max/min only a non-negative scalar would — disallow)
+            if partial_ops and (op is not jnp.multiply or any(o not in ("sum", "avg") for o in partial_ops)):
+                raise ValueError("only scalar * on Partial(sum/avg) is safe; redistribute first")
+            a, b = self._data, other
+        if reverse:
+            a, b = b, a
+        return DArray(op(a, b), self._spec)
+
+    def __add__(self, o):
+        return self._elementwise(o, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._elementwise(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return self._elementwise(o, jnp.subtract, reverse=True)
+
+    def __mul__(self, o):
+        return self._elementwise(o, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._elementwise(o, jnp.divide)
+
+    def __neg__(self):
+        if any(o not in ("sum", "avg") for o in self._partial_ops()):
+            raise ValueError("negation does not commute with max/min Partial; redistribute first")
+        return DArray(-self._data, self._spec)
+
+    def astype(self, dtype) -> "DArray":
+        spec = DArraySpec(self.mesh, self.placements, TensorMeta(self.shape, jnp.dtype(dtype)))
+        return DArray(self._data.astype(dtype), spec)
+
+
+# ---------------------------------------------------------------- helpers
+def _local_view(physical, spec: DArraySpec, coord):
+    lay = spec.layout()
+    x = physical
+    # 1. index the leading partial axes at this coord
+    if lay.partial_mesh_dims:
+        idx = tuple(coord[i] for i in lay.partial_mesh_dims)
+        x = x[idx]
+    # 2. ragged: slice this rank's cell, unpadded
+    if lay.ragged is not None:
+        size, _off = spec.ragged_local_chunk(coord)
+        rj, _ = lay.ragged
+        s = spec.mesh.shape[lay.ragged_inner_shard] if lay.ragged_inner_shard is not None else 1
+        a = coord[lay.ragged_inner_shard] if lay.ragged_inner_shard is not None else 0
+        nj = spec.mesh.shape[rj]
+        start = (a * nj + coord[rj]) * lay.cell_pad
+        return jax.lax.dynamic_slice(x, (start,), (size,))
+    # 3. body-axis slicing: each rank's slot is at flat_rank * chunk in the
+    # (possibly padded) physical axis, trimmed to the true local extent
+    slices = tuple(_body_slice(info, spec, coord) for info in lay.body_axes)
+    x = x[slices]
+    # collapse interleave factors back to the reference's local layout
+    # (concat of per-section chunks == reshape (m, chunk) -> m*chunk)
+    interleaved_dims = dict(lay.interleaves)
+    if interleaved_dims:
+        new_shape = []
+        shp = list(x.shape)
+        k = 0
+        for dim in range(len(spec.shape)):
+            if dim in interleaved_dims:
+                new_shape.append(shp[k] * shp[k + 1])
+                k += 2
+            else:
+                new_shape.append(shp[k])
+                k += 1
+        x = jnp.reshape(x, tuple(new_shape))
+    return x
+
+
+def _body_slice(info, spec: DArraySpec, coord) -> slice:
+    """Local slice of one body physical axis for a device coordinate."""
+    from .spec import nested_chunk
+
+    if not info.mesh_dims:
+        return slice(None)
+    sizes = [spec.mesh.shape[i] for i in info.mesh_dims]
+    idx = [coord[i] for i in info.mesh_dims]
+    ext, _off = nested_chunk(info.extent, sizes, idx)
+    flat_r = int(np.ravel_multi_index(idx, sizes))
+    start = flat_r * info.chunk
+    return slice(start, start + ext)
+
+
+# ------------------------------------------------------------------- API
+def distribute_tensor(tensor, mesh: DeviceMesh, placements=None) -> DArray:
+    """Shard/replicate a full logical tensor onto the mesh (reference
+    api.py:154).  Works eagerly (device_put) and inside jit (GSPMD
+    constraint)."""
+    tensor = tensor if _is_traced(tensor) else jnp.asarray(tensor)
+    spec = DArraySpec(
+        mesh,
+        normalize_placements(placements, mesh.ndim, tensor.ndim),
+        TensorMeta(tuple(tensor.shape), tensor.dtype),
+    )
+    phys = spec.pack(tensor)
+    return DArray(_apply_sharding(phys, spec), spec)
+
+
+def from_local(
+    local_tensor,
+    device_mesh: DeviceMesh,
+    placements=None,
+    *,
+    run_check: bool = False,
+    shape: Optional[Sequence[int]] = None,
+) -> DArray:
+    """Assemble a DArray from per-rank local tensors (reference api.py:39).
+
+    ``local_tensor`` is either one array — treated as every rank's local
+    (the SPMD code-path of the reference) — or a list of ``mesh.size()``
+    arrays in flat-rank order (the single-controller test path).
+    """
+    if isinstance(local_tensor, (list, tuple)):
+        locals_ = [np.asarray(t) for t in local_tensor]
+        if len(locals_) != device_mesh.size():
+            raise ValueError(f"need {device_mesh.size()} locals, got {len(locals_)}")
+    else:
+        locals_ = None
+        single = jnp.asarray(local_tensor)
+
+    placements = normalize_placements(
+        placements, device_mesh.ndim, (locals_[0].ndim if locals_ else single.ndim)
+    )
+
+    if locals_ is None:
+        # every rank holds `single`: infer global shape by scaling shard dims
+        gshape = list(single.shape)
+        for i, p in enumerate(placements):
+            if isinstance(p, (Shard, InterleavedShard)):
+                gshape[p.dim] *= device_mesh.shape[i]
+            elif isinstance(p, RaggedShard):
+                raise ValueError("ragged from_local requires a list of locals or explicit shape")
+        spec = DArraySpec(device_mesh, placements, TensorMeta(tuple(shape or gshape), single.dtype))
+        if spec.has_partial() or any(isinstance(p, (Shard, InterleavedShard)) for p in placements):
+            locals_ = [np.asarray(single)] * device_mesh.size()
+        else:
+            return DArray(_apply_sharding(single, spec), spec)
+
+    # infer logical global shape from locals
+    if shape is None:
+        r0 = locals_[0]
+        gshape = list(r0.shape)
+        for i, p in enumerate(placements):
+            if type(p) is Shard:
+                # sum local sizes walking ranks along mesh dim i at zero-coords
+                total = 0
+                for r in range(device_mesh.shape[i]):
+                    coord = [0] * device_mesh.ndim
+                    coord[i] = r
+                    flat = int(np.ravel_multi_index(coord, device_mesh.shape))
+                    total += locals_[flat].shape[p.dim]
+                gshape[p.dim] = total
+            elif isinstance(p, InterleavedShard):
+                gshape[p.dim] = r0.shape[p.dim] * device_mesh.shape[i]
+            elif isinstance(p, RaggedShard):
+                total = 0
+                for r in range(device_mesh.shape[i]):
+                    coord = [0] * device_mesh.ndim
+                    coord[i] = r
+                    flat = int(np.ravel_multi_index(coord, device_mesh.shape))
+                    total += locals_[flat].size
+                gshape = [total]
+        shape = tuple(gshape)
+    spec = DArraySpec(device_mesh, placements, TensorMeta(tuple(shape), jnp.asarray(locals_[0]).dtype))
+    phys = _assemble_physical(spec, locals_)
+    return DArray(_apply_sharding(jnp.asarray(phys), spec), spec)
+
+
+def _assemble_physical(spec: DArraySpec, locals_) -> np.ndarray:
+    """Build the physical global array from per-rank local logical chunks."""
+    lay = spec.layout()
+    phys = np.zeros(lay.physical_shape, dtype=np.asarray(locals_[0]).dtype)
+    for r in range(spec.mesh.size()):
+        coord = spec.mesh.coordinate_of_rank(r)
+        loc = np.asarray(locals_[r])
+        lead = tuple(coord[i] for i in lay.partial_mesh_dims)
+        if lay.ragged is not None:
+            size, _ = spec.ragged_local_chunk(coord)
+            rj, _p = lay.ragged
+            s_n = spec.mesh.shape[lay.ragged_inner_shard] if lay.ragged_inner_shard is not None else 1
+            a = coord[lay.ragged_inner_shard] if lay.ragged_inner_shard is not None else 0
+            start = (a * spec.mesh.shape[rj] + coord[rj]) * lay.cell_pad
+            flat = loc.ravel()
+            if flat.size != size:
+                raise ValueError(f"rank {r}: ragged local size {flat.size} != expected {size}")
+            phys[lead + (slice(start, start + size),)] = flat
+            continue
+        # body-space slices (mirror _local_view's slot math)
+        slices = tuple(_body_slice(info, spec, coord) for info in lay.body_axes)
+        body_shape = tuple((s.stop - s.start) if isinstance(s, slice) and s.start is not None else n
+                           for s, n in zip(slices, (ph for ph in lay.physical_shape[len(lead):])))
+        body = loc.reshape(body_shape)
+        phys[lead + slices] = body
+    return phys
+
+
+def redistribute_dtensor(dtensor: DArray, device_mesh=None, placements=None, async_op: bool = True) -> DArray:
+    """Reference api.py:281."""
+    return dtensor.redistribute(device_mesh, placements)
+
+
+def full_tensor(dtensor: DArray):
+    return dtensor.full_tensor()
+
+
+# --------------------------------------------------------------- factories
+def _factory(fill_fn, shape, mesh, placements, dtype):
+    spec = DArraySpec(
+        mesh, normalize_placements(placements, mesh.ndim, len(shape)), TensorMeta(tuple(shape), jnp.dtype(dtype))
+    )
+    # Generate the *logical global* value then shard: bitwise identical to a
+    # single-device run by construction (the property the reference needed a
+    # patched CUDA philox for).  XLA partitions the generator under jit.
+    logical = fill_fn(tuple(shape), jnp.dtype(dtype))
+    phys = spec.pack(logical)
+    return DArray(_apply_sharding(phys, spec), spec)
+
+
+def zeros(*shape, device_mesh: DeviceMesh, placements=None, dtype=jnp.float32) -> DArray:
+    shape = shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape
+    return _factory(lambda s, d: jnp.zeros(s, d), shape, device_mesh, placements, dtype)
+
+
+def ones(*shape, device_mesh: DeviceMesh, placements=None, dtype=jnp.float32) -> DArray:
+    shape = shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape
+    return _factory(lambda s, d: jnp.ones(s, d), shape, device_mesh, placements, dtype)
+
+
+def empty(*shape, device_mesh: DeviceMesh, placements=None, dtype=jnp.float32) -> DArray:
+    return zeros(*shape, device_mesh=device_mesh, placements=placements, dtype=dtype)
+
+
+def full(shape, fill_value, *, device_mesh: DeviceMesh, placements=None, dtype=jnp.float32) -> DArray:
+    return _factory(lambda s, d: jnp.full(s, fill_value, d), shape, device_mesh, placements, dtype)
+
+
+def randn(*shape, device_mesh: DeviceMesh, placements=None, dtype=jnp.float32, key=None) -> DArray:
+    from .random import get_rng_tracker
+
+    shape = shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape
+    tracker = get_rng_tracker()
+    return _factory(lambda s, d: tracker.normal(s, d, key=key), shape, device_mesh, placements, dtype)
+
+
+def rand(*shape, device_mesh: DeviceMesh, placements=None, dtype=jnp.float32, key=None) -> DArray:
+    from .random import get_rng_tracker
+
+    shape = shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape
+    tracker = get_rng_tracker()
+    return _factory(lambda s, d: tracker.uniform(s, d, key=key), shape, device_mesh, placements, dtype)
+
+
+def arange(*args, device_mesh: DeviceMesh, placements=None, dtype=None) -> DArray:
+    logical = jnp.arange(*args, dtype=dtype)
+    spec = DArraySpec(
+        device_mesh,
+        normalize_placements(placements, device_mesh.ndim, 1),
+        TensorMeta(tuple(logical.shape), logical.dtype),
+    )
+    return DArray(_apply_sharding(spec.pack(logical), spec), spec)

@@ -1,0 +1,148 @@
+"""Shard-aware deterministic RNG.
+
+Reference: legacy/vescale/dtensor/random.py (OffsetBasedRNGTracker:167,
+ThreadBasedRNGTracker:340, TensorParallelRNGTracker:521) + the CUDA patch
+that injects (local_shape, global_offset, global_shape, global_strides) into
+the philox state so every GPU thread draws bits at its *global* element index
+(SURVEY §2.2 row 1).
+
+TPU-native design: JAX's threefry is already a counter-based PRNG over the
+global iota.  With ``jax_threefry_partitionable`` enabled (done at import
+here), generating under ANY GSPMD sharding is bitwise identical to the
+single-device run, each device computing only its shard's counters — the
+exact property the reference needed a patched ATen for, with zero native
+code.  The tracker below adds veScale's management surface: a seeded
+tracker with named parallel-region streams (tensor-parallel vs replicate
+regions), distribute-region key derivation, and dropout helpers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import zlib
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_threefry_partitionable", True)
+
+__all__ = [
+    "manual_seed",
+    "get_rng_tracker",
+    "RNGStateTracker",
+    "OffsetBasedRNGTracker",
+    "ThreadBasedRNGTracker",
+    "TensorParallelRNGTracker",
+    "uniform",
+    "normal",
+    "dropout",
+]
+
+
+class RNGStateTracker(threading.local):
+    """Holds the seeded base key plus named sub-streams
+    (reference RNGStateTracker, random.py:115)."""
+
+    def __init__(self, seed: int = 0):
+        super().__init__()
+        self.seed(seed)
+
+    def seed(self, seed: int) -> None:
+        self._seed = int(seed)
+        self._base = jax.random.key(self._seed)
+        self._streams = {}
+        self._counters = {}
+
+    @property
+    def base_key(self):
+        return self._base
+
+    def stream(self, name: str = "default"):
+        """A named, stateless stream key (e.g. "tensor-parallel").  Uses a
+        stable digest (not ``hash``) so keys are identical across processes
+        and runs regardless of PYTHONHASHSEED."""
+        if name not in self._streams:
+            digest = zlib.crc32(name.encode("utf-8")) & 0x7FFFFFFF
+            self._streams[name] = jax.random.fold_in(self._base, digest)
+        return self._streams[name]
+
+    def next_key(self, name: str = "default"):
+        """Stateful convenience: successive calls give independent keys while
+        remaining a pure function of (seed, name, call index)."""
+        c = self._counters.get(name, 0)
+        self._counters[name] = c + 1
+        return jax.random.fold_in(self.stream(name), c)
+
+    @contextlib.contextmanager
+    def _distribute_region(self, spec=None, name: str = "default"):
+        """Parity with the reference's context entered around random ops in
+        dispatch (dispatch.py:235-320).  Under GSPMD nothing extra is needed
+        — partitionable threefry makes sharded generation globally
+        consistent — so this simply scopes a key."""
+        yield self.next_key(name)
+
+    # ----------------------------------------------------------- sampling
+    def uniform(self, shape, dtype=jnp.float32, *, key=None, minval=0.0, maxval=1.0, name: str = "default"):
+        key = key if key is not None else self.next_key(name)
+        return jax.random.uniform(key, shape, dtype=dtype, minval=minval, maxval=maxval)
+
+    def normal(self, shape, dtype=jnp.float32, *, key=None, name: str = "default"):
+        key = key if key is not None else self.next_key(name)
+        return jax.random.normal(key, shape, dtype=dtype)
+
+    def dropout(self, x, rate: float, *, key=None, name: str = "default"):
+        """Global-semantics dropout: the mask is a function of global element
+        position — bitwise single-device-equal under any sharding (the
+        reference's patched-philox Dropout.cu behaviour)."""
+        if rate == 0.0:
+            return x
+        key = key if key is not None else self.next_key(name)
+        keep = jax.random.bernoulli(key, 1.0 - rate, shape=x.shape)
+        return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+# The three reference trackers collapse into one implementation on TPU; the
+# aliases keep the migration surface intact.  ThreadBasedRNGTracker's
+# "exact single-device semantics" (env VESCALE_SINGLE_DEVICE_RAND) is the
+# default and only mode here.
+class OffsetBasedRNGTracker(RNGStateTracker):
+    pass
+
+
+class ThreadBasedRNGTracker(RNGStateTracker):
+    pass
+
+
+class TensorParallelRNGTracker(RNGStateTracker):
+    pass
+
+
+_TRACKER: Optional[RNGStateTracker] = None
+
+
+def get_rng_tracker() -> RNGStateTracker:
+    global _TRACKER
+    if _TRACKER is None:
+        _TRACKER = RNGStateTracker(0)
+    return _TRACKER
+
+
+def manual_seed(seed: int, device_mesh=None) -> None:
+    """Seed the global tracker (reference random.py:62).  ``device_mesh`` is
+    accepted for parity; in the single-controller model every process seeds
+    identically."""
+    get_rng_tracker().seed(seed)
+
+
+def uniform(shape, dtype=jnp.float32, **kw):
+    return get_rng_tracker().uniform(shape, dtype, **kw)
+
+
+def normal(shape, dtype=jnp.float32, **kw):
+    return get_rng_tracker().normal(shape, dtype, **kw)
+
+
+def dropout(x, rate: float, **kw):
+    return get_rng_tracker().dropout(x, rate, **kw)

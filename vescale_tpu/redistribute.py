@@ -1,0 +1,85 @@
+"""Redistribute engine — placement transitions as XLA collectives.
+
+Reference: legacy/vescale/dtensor/redistribute.py:223 implements a per-pair
+transition table (allgather / reduce-scatter / all-reduce / all-to-all /
+scatter, with pad/unpad for uneven shards) issuing NCCL ops eagerly.
+
+TPU-native design: a transition is ``unpack -> reduce partials -> pack`` in
+the logical domain with the *destination* sharding attached.  Under ``jit``
+XLA compiles exactly the collectives of the reference's table:
+
+  Partial -> Replicate    == psum (all-reduce)
+  Partial -> Shard(d)     == psum_scatter (reduce-scatter)
+  Shard(d) -> Replicate   == all-gather (+ implicit unpad for uneven)
+  Shard(d) -> Shard(d')   == all-to-all
+  Replicate -> Shard(d)   == local slice (no comm)
+  RaggedShard -> Replicate== all-gather-v  (gather + unpad, placement_types.py:128)
+  RaggedShard -> RaggedShard' == all-to-all-v (placement_types.py:152)
+
+Eagerly, ``jax.device_put`` between shardings performs the device-to-device
+resharding transfer.  Cross-mesh redistribution (reference
+CrossMeshRedistribute, redistribute.py:562) round-trips through the logical
+value as well.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .darray import DArray, _apply_sharding, _is_traced
+from .mesh import DeviceMesh
+from .placements import normalize_placements
+from .spec import DArraySpec, TensorMeta
+
+__all__ = ["redistribute", "redistribute_local_tensor"]
+
+
+def redistribute(darr: DArray, placements, mesh: Optional[DeviceMesh] = None) -> DArray:
+    dst_mesh = mesh or darr.mesh
+    dst_placements = normalize_placements(placements, dst_mesh.ndim, darr.ndim)
+    src = darr.spec
+    dst = DArraySpec(dst_mesh, dst_placements, TensorMeta(src.shape, src.dtype))
+    if dst == src:
+        return darr
+
+    # Fast path: same mesh, no partial/ragged/interleave on either side —
+    # the physical array is the logical array; let XLA/jax reshard directly
+    # without a pack/unpack round-trip.
+    trivial = (
+        dst_mesh == darr.mesh
+        and not src.has_partial()
+        and not dst.has_partial()
+        and not src.has_ragged()
+        and not dst.has_ragged()
+        and not src.layout().interleaves
+        and not dst.layout().interleaves
+        and not src.layout().any_padded
+        and not dst.layout().any_padded
+    )
+    if trivial:
+        return DArray(_apply_sharding(darr.data, dst), dst)
+
+    logical = src.unpack(darr.data)
+    phys = dst.pack(logical)
+    return DArray(_apply_sharding(phys, dst), dst)
+
+
+def redistribute_local_tensor(locals_, src_spec: DArraySpec, dst_spec: DArraySpec, rank: int = 0):
+    """Transition local tensors between specs (reference redistribute.py:223)
+    and return ``rank``'s destination local.  Single-controller semantics:
+    ``locals_`` must be the full per-rank list (flat-rank order), or a single
+    tensor only when the source is fully replicated — any other transition
+    would require the other ranks' data and cannot be fabricated."""
+    from .darray import from_local
+
+    if not isinstance(locals_, (list, tuple)):
+        if not src_spec.is_replicated():
+            raise ValueError(
+                "single-local redistribute is only defined for a replicated "
+                "source; pass the full per-rank list of locals"
+            )
+        locals_ = [locals_] * src_spec.mesh.size()
+    d = from_local(list(locals_), src_spec.mesh, src_spec.placements, shape=src_spec.shape)
+    return redistribute(d, dst_spec.placements, mesh=dst_spec.mesh).to_local(rank=rank)

@@ -1,0 +1,512 @@
+"""DArraySpec — layout algebra lowering placements onto GSPMD.
+
+This replaces the reference's DTensorSpec + per-op sharding propagation
+(legacy/vescale/dtensor/placement_types.py:399, sharding_prop.py:54).  On TPU
+there is no per-op dispatch: a spec lowers *once* to a physical array shape +
+``jax.sharding.PartitionSpec``, and XLA propagates shardings at trace time.
+
+Physical representation rules (the "clean layout algebra" the reference's
+ragged composition lacked — see SURVEY §7 hard parts):
+
+  logical array  --pack-->  physical array  (stored in DArray._data)
+
+  * ``Shard(d)``            — mesh axis name attached to dim ``d`` of the
+                              PartitionSpec; nested shards on one dim keep
+                              mesh-dim order (earlier = outer).  Uneven
+                              extents are padded to ``prod(n) * chunk`` with
+                              each rank's data at ``flat_rank * chunk``
+                              (ceil-division chunking, matching the
+                              reference's Shard semantics and GSPMD's).
+  * ``InterleavedShard(d,m)``— dim d reshaped to (m, S[d]/m); the mesh axis
+                              shards the *second* factor, so XLA sees an even
+                              contiguous shard while rank-local data equals
+                              the reference's interleaved layout
+                              (placement_types.py:284).
+  * ``Partial``             — one leading stacked axis per partial mesh dim
+                              (in mesh-dim order), sharded on that mesh dim;
+                              the logical value is the reduction over those
+                              axes.  Reductions lower to psum/reduce-scatter.
+  * ``RaggedShard(dims,u)`` — ``dims`` flattened; per-rank ragged chunks are
+                              padded to ``max_chunk`` and packed rank-major so
+                              XLA sees an even Shard(0) of a flat buffer
+                              (all-gather-v == all-gather + unpad).
+  * ``StridedRaggedShard``  — ragged split applied FIRST (outer) across its
+                              mesh dim; the composed even ``Shard`` on the
+                              same flat extent splits *within* each ragged
+                              chunk.  split_factor must equal that inner mesh
+                              dim's size.  (fsdp x ep layouts.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .mesh import DeviceMesh
+from .placements import (
+    InterleavedShard,
+    Partial,
+    Placement,
+    RaggedShard,
+    Replicate,
+    Shard,
+    StridedRaggedShard,
+    normalize_placements,
+)
+
+__all__ = ["DArraySpec", "TensorMeta"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorMeta:
+    """Logical (global) tensor metadata (reference placement_types.py:373)."""
+
+    shape: Tuple[int, ...]
+    dtype: Any
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+
+def _prod(xs) -> int:
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def _ceil(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def nested_chunk(extent: int, sizes: Sequence[int], idx: Sequence[int]) -> Tuple[int, int]:
+    """(local_size, logical_offset) after nested ceil-chunking of ``extent``
+    by mesh-dim sizes ``sizes`` at coordinates ``idx`` (outer-to-inner)."""
+    ext, off = extent, 0
+    for n, r in zip(sizes, idx):
+        c = _ceil(ext, n)
+        o = min(c * r, ext)
+        ext = min(c, ext - o)
+        off += o
+    return ext, off
+
+
+def innermost_chunk(extent: int, sizes: Sequence[int]) -> int:
+    c = extent
+    for n in sizes:
+        c = _ceil(c, n)
+    return c
+
+
+@dataclasses.dataclass(frozen=True)
+class _AxisInfo:
+    """Sharding info for one body (physical, non-lead) axis."""
+
+    mesh_dims: Tuple[int, ...]  # mesh dims sharding this axis, outer-to-inner
+    extent: int                 # true (data) extent
+    chunk: int                  # per-rank slot size (innermost ceil chunk)
+    padded: int                 # chunk * prod(sizes)  (== extent when even)
+
+    @property
+    def is_padded(self) -> bool:
+        return self.padded != self.extent
+
+
+@dataclasses.dataclass(frozen=True)
+class _Layout:
+    physical_shape: Tuple[int, ...]
+    pspec: PartitionSpec
+    partial_mesh_dims: Tuple[int, ...]
+    interleaves: Tuple[Tuple[int, int], ...]  # (logical_dim, m), sorted
+    body_axes: Tuple[_AxisInfo, ...]          # per body physical axis
+    ragged: Optional[Tuple[int, RaggedShard]]
+    ragged_inner_shard: Optional[int]
+    cell_pad: int
+
+    @property
+    def any_padded(self) -> bool:
+        return any(a.is_padded for a in self.body_axes)
+
+
+class DArraySpec:
+    """mesh + placements + logical tensor meta, with cached lowering."""
+
+    __slots__ = ("mesh", "placements", "meta", "_layout")
+
+    def __init__(self, mesh: DeviceMesh, placements, meta: TensorMeta):
+        self.mesh = mesh
+        self.placements: Tuple[Placement, ...] = normalize_placements(
+            placements, mesh.ndim, len(meta.shape)
+        )
+        self.meta = meta
+        self._layout: Optional[_Layout] = None
+
+    # ------------------------------------------------------------- basics
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.meta.shape
+
+    @property
+    def dtype(self):
+        return self.meta.dtype
+
+    @property
+    def ndim(self) -> int:
+        return len(self.meta.shape)
+
+    def is_replicated(self) -> bool:
+        return all(p.is_replicate() for p in self.placements)
+
+    def has_partial(self) -> bool:
+        return any(p.is_partial() for p in self.placements)
+
+    def has_ragged(self) -> bool:
+        return any(p.is_ragged_shard() for p in self.placements)
+
+    def with_placements(self, placements) -> "DArraySpec":
+        return DArraySpec(self.mesh, placements, self.meta)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, DArraySpec)
+            and self.mesh == other.mesh
+            and self.placements == other.placements
+            and self.meta == other.meta
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.mesh, self.placements, self.meta))
+
+    def __repr__(self) -> str:
+        ps = ", ".join(str(p) for p in self.placements)
+        return f"DArraySpec([{ps}] over {dict(zip(self.mesh.mesh_dim_names, self.mesh.shape))}, shape={self.shape})"
+
+    # ----------------------------------------------------------- lowering
+    def layout(self) -> _Layout:
+        if self._layout is None:
+            self._layout = self._compute_layout()
+        return self._layout
+
+    def _compute_layout(self) -> _Layout:
+        mesh, placements, shape = self.mesh, self.placements, self.meta.shape
+
+        ragged = [(i, p) for i, p in enumerate(placements) if isinstance(p, RaggedShard)]
+        if len(ragged) > 1:
+            raise ValueError("at most one RaggedShard placement per DArray")
+        if ragged:
+            return self._compute_ragged_layout(ragged[0])
+
+        partial_dims = tuple(i for i, p in enumerate(placements) if p.is_partial())
+
+        # interleave reshapes (at most one per logical dim; no mixing with
+        # plain Shard on the same dim)
+        interleaves = {}
+        for i, p in enumerate(placements):
+            if isinstance(p, InterleavedShard):
+                if p.dim in interleaves and interleaves[p.dim] != p.interleaved_size:
+                    raise ValueError(f"conflicting interleaved sizes on dim {p.dim}")
+                if shape[p.dim] % p.interleaved_size != 0:
+                    raise ValueError(
+                        f"dim {p.dim} size {shape[p.dim]} not divisible by interleaved_size {p.interleaved_size}"
+                    )
+                interleaves[p.dim] = p.interleaved_size
+        for i, p in enumerate(placements):
+            if type(p) is Shard and p.dim in interleaves:
+                raise ValueError(f"cannot mix Shard and InterleavedShard on dim {p.dim}")
+
+        # body physical axes after interleave reshapes
+        body_extents: List[int] = []
+        shard_axis_of: List[int] = []  # logical dim -> body axis of shardable factor
+        for d, s in enumerate(shape):
+            if d in interleaves:
+                m = interleaves[d]
+                body_extents.extend([m, s // m])
+                shard_axis_of.append(len(body_extents) - 1)
+            else:
+                body_extents.append(s)
+                shard_axis_of.append(len(body_extents) - 1)
+
+        axis_mesh_dims: List[List[int]] = [[] for _ in body_extents]
+        for i, p in enumerate(placements):
+            if isinstance(p, (Shard, InterleavedShard)):
+                axis_mesh_dims[shard_axis_of[p.dim]].append(i)
+
+        body_axes: List[_AxisInfo] = []
+        for ax, ext in enumerate(body_extents):
+            dims = tuple(axis_mesh_dims[ax])
+            sizes = [mesh.shape[i] for i in dims]
+            chunk = innermost_chunk(ext, sizes) if dims else ext
+            padded = chunk * _prod(sizes) if dims else ext
+            body_axes.append(_AxisInfo(dims, ext, chunk, padded))
+
+        lead_shape = [mesh.shape[i] for i in partial_dims]
+        lead_names = [[mesh.dim_name(i)] for i in partial_dims]
+        body_names = [[mesh.dim_name(i) for i in a.mesh_dims] for a in body_axes]
+        full_names = lead_names + body_names
+        pspec = PartitionSpec(
+            *(None if not ns else (ns[0] if len(ns) == 1 else tuple(ns)) for ns in full_names)
+        )
+        return _Layout(
+            physical_shape=tuple(lead_shape + [a.padded for a in body_axes]),
+            pspec=pspec,
+            partial_mesh_dims=partial_dims,
+            interleaves=tuple(sorted(interleaves.items())),
+            body_axes=tuple(body_axes),
+            ragged=None,
+            ragged_inner_shard=None,
+            cell_pad=0,
+        )
+
+    def _compute_ragged_layout(self, ragged_entry) -> _Layout:
+        mesh, placements, shape = self.mesh, self.placements, self.meta.shape
+        rj, rp = ragged_entry
+        partial_dims = tuple(i for i, p in enumerate(placements) if p.is_partial())
+        inner_shard = None
+        for i, p in enumerate(placements):
+            if i == rj or p.is_partial() or p.is_replicate():
+                continue
+            if type(p) is Shard:
+                if isinstance(rp, StridedRaggedShard) and p.dim == rp.dims[0] and inner_shard is None:
+                    inner_shard = i
+                    continue
+            raise ValueError(
+                "RaggedShard composes only with Replicate/Partial (or one even "
+                f"Shard via StridedRaggedShard); got {p} on mesh dim {i}"
+            )
+        if isinstance(rp, StridedRaggedShard):
+            if inner_shard is None and rp.split_factor != 1:
+                raise ValueError("StridedRaggedShard.split_factor set but no composing Shard found")
+            if inner_shard is not None and mesh.shape[inner_shard] != rp.split_factor:
+                raise ValueError(
+                    f"split_factor {rp.split_factor} != size of composing mesh dim {mesh.shape[inner_shard]}"
+                )
+        if rp.dims[0] != 0 or rp.dims[-1] != len(shape) - 1:
+            # round-1 semantics: ragged flattens the whole tensor (the
+            # reference's FSDP usage flattens whole param groups too)
+            if any(shape[d] != 1 for d in range(len(shape)) if d not in rp.dims):
+                raise ValueError("RaggedShard must cover all non-trivial dims")
+        if partial_dims:
+            raise ValueError("Partial + RaggedShard composition is not supported")
+
+        flat = _prod(shape)
+        nj = mesh.shape[rj]
+        if len(rp.local_units) != nj:
+            raise ValueError(f"local_units {rp.local_units} != mesh dim size {nj}")
+        sizes, _ = rp.local_sizes_and_offsets(flat)
+        s = mesh.shape[inner_shard] if inner_shard is not None else 1
+        cell_sizes = []
+        for sz in sizes:
+            if sz % s != 0:
+                raise ValueError(f"ragged chunk {sz} not divisible by inner shard factor {s}")
+            cell_sizes.append(sz // s)
+        cell_pad = max(cell_sizes) if cell_sizes else 0
+
+        names = []
+        if inner_shard is not None:
+            names.append(mesh.dim_name(inner_shard))
+        names.append(mesh.dim_name(rj))
+        pspec = PartitionSpec(tuple(names) if len(names) > 1 else names[0])
+        return _Layout(
+            physical_shape=(s * nj * cell_pad,),
+            pspec=pspec,
+            partial_mesh_dims=(),
+            interleaves=(),
+            body_axes=(),
+            ragged=(rj, rp),
+            ragged_inner_shard=inner_shard,
+            cell_pad=cell_pad,
+        )
+
+    # ------------------------------------------------------ pack / unpack
+    def pack(self, logical, partial_seed: bool = True):
+        """logical global array -> physical array (jit-traceable).
+
+        ``partial_seed``: seeding of Partial stacks when *distributing* a
+        full value — "sum" puts the value in slot 0 and zeros elsewhere;
+        "avg"/"max"/"min" replicate (any-slot reduction reproduces it)."""
+        lay = self.layout()
+        x = jnp.asarray(logical, dtype=self.meta.dtype)
+        if lay.ragged is not None:
+            return self._pack_ragged(x)
+        for d, m in sorted(lay.interleaves, reverse=True):
+            new_shape = x.shape[:d] + (m, x.shape[d] // m) + x.shape[d + 1:]
+            x = jnp.reshape(x, new_shape)
+        if lay.any_padded:
+            x = self._repack_padded(x, to_physical=True)
+        # leading partial axes (stack innermost-first, then reorder)
+        k = len(lay.partial_mesh_dims)
+        for mesh_dim in lay.partial_mesh_dims:
+            n = self.mesh.shape[mesh_dim]
+            op = self.placements[mesh_dim].reduce_op  # type: ignore[attr-defined]
+            if partial_seed and op == "sum":
+                zero = jnp.zeros_like(x)
+                x = jnp.stack([x] + [zero] * (n - 1), axis=0)
+            else:
+                x = jnp.stack([x] * n, axis=0)
+        if k > 1:
+            x = jnp.moveaxis(x, tuple(range(k)), tuple(reversed(range(k))))
+        return x
+
+    def unpack(self, physical):
+        """physical array -> logical global array (reduces Partial axes)."""
+        lay = self.layout()
+        x = physical
+        for mesh_dim in lay.partial_mesh_dims:
+            op = self.placements[mesh_dim].reduce_op  # type: ignore[attr-defined]
+            if op == "sum":
+                x = jnp.sum(x, axis=0)
+            elif op == "avg":
+                x = jnp.mean(x, axis=0)
+            elif op == "max":
+                x = jnp.max(x, axis=0)
+            else:
+                x = jnp.min(x, axis=0)
+        if lay.ragged is not None:
+            return self._unpack_ragged(x)
+        if lay.any_padded:
+            x = self._repack_padded(x, to_physical=False)
+        for k, (d, m) in enumerate(sorted(lay.interleaves)):
+            # earlier merges collapsed k axis pairs, shifting positions left
+            pd = self._body_axis_of(d) - k
+            new_shape = x.shape[:pd] + (m * x.shape[pd + 1],) + x.shape[pd + 2:]
+            x = jnp.reshape(x, new_shape)
+        return x
+
+    def _repack_padded(self, x, to_physical: bool):
+        """Move data between true-extent and padded layouts, axis by axis
+        (static loops; used only by the eager API on uneven shapes)."""
+        lay = self.layout()
+        for ax, info in enumerate(lay.body_axes):
+            if not info.is_padded:
+                continue
+            sizes = [self.mesh.shape[i] for i in info.mesh_dims]
+            total = _prod(sizes)
+            src_ext = info.extent if to_physical else info.padded
+            dst_ext = info.padded if to_physical else info.extent
+            dst_shape = x.shape[:ax] + (dst_ext,) + x.shape[ax + 1:]
+            out = jnp.zeros(dst_shape, x.dtype)
+            for r in range(total):
+                idx = np.unravel_index(r, sizes)
+                ext, off = nested_chunk(info.extent, sizes, idx)
+                if ext == 0:
+                    continue
+                if to_physical:
+                    src_s, dst_s = off, r * info.chunk
+                else:
+                    src_s, dst_s = r * info.chunk, off
+                src_idx = tuple(slice(None) for _ in range(ax)) + (slice(src_s, src_s + ext),)
+                piece = x[src_idx]
+                starts = [0] * x.ndim
+                starts[ax] = dst_s
+                out = jax.lax.dynamic_update_slice(out, piece, tuple(starts))
+            x = out
+        return x
+
+    def _pack_ragged(self, x):
+        lay = self.layout()
+        rj, rp = lay.ragged
+        flat = jnp.ravel(x)
+        sizes, offs = rp.local_sizes_and_offsets(flat.shape[0])
+        s = self.mesh.shape[lay.ragged_inner_shard] if lay.ragged_inner_shard is not None else 1
+        nj = self.mesh.shape[rj]
+        out = jnp.zeros((s * nj * lay.cell_pad,), dtype=x.dtype)
+        for r in range(nj):
+            cell = sizes[r] // s
+            if cell == 0:
+                continue
+            for a in range(s):
+                src = jax.lax.dynamic_slice(flat, (offs[r] + a * cell,), (cell,))
+                out = jax.lax.dynamic_update_slice(out, src, ((a * nj + r) * lay.cell_pad,))
+        return out
+
+    def _unpack_ragged(self, flat_phys):
+        lay = self.layout()
+        rj, rp = lay.ragged
+        total = _prod(self.meta.shape)
+        sizes, offs = rp.local_sizes_and_offsets(total)
+        s = self.mesh.shape[lay.ragged_inner_shard] if lay.ragged_inner_shard is not None else 1
+        nj = self.mesh.shape[rj]
+        out = jnp.zeros((total,), dtype=flat_phys.dtype)
+        for r in range(nj):
+            cell = sizes[r] // s
+            if cell == 0:
+                continue
+            for a in range(s):
+                src = jax.lax.dynamic_slice(flat_phys, ((a * nj + r) * lay.cell_pad,), (cell,))
+                out = jax.lax.dynamic_update_slice(out, src, (offs[r] + a * cell,))
+        return jnp.reshape(out, self.meta.shape)
+
+    def _body_axis_of(self, logical_dim: int) -> int:
+        """Body axis index of logical dim's first factor."""
+        off = 0
+        for d, _m in self.layout().interleaves:
+            if d < logical_dim:
+                off += 1
+        return logical_dim + off
+
+    # --------------------------------------------------------- shardings
+    def named_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh.jax_mesh, self.layout().pspec)
+
+    def logical_pspec(self) -> PartitionSpec:
+        """PartitionSpec of the *logical* array for with_sharding_constraint
+        in jit code (Partial/Interleaved/Ragged mesh dims contribute None —
+        XLA handles partials itself at trace time)."""
+        names: List[List[str]] = [[] for _ in self.meta.shape]
+        for i, p in enumerate(self.placements):
+            if type(p) is Shard:
+                names[p.dim].append(self.mesh.dim_name(i))
+        return PartitionSpec(
+            *(None if not ns else (ns[0] if len(ns) == 1 else tuple(ns)) for ns in names)
+        )
+
+    # -------------------------------------------- per-rank chunk queries
+    def local_chunk(self, coord: Sequence[int]) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        """(local logical shape, global offsets) for the device at mesh
+        coordinate ``coord``.  Shard/Replicate/Partial layouts (Partial local
+        == full shape at offset 0).  Used by RNG, checkpoint and
+        from_local/to_local.  Ragged uses ``ragged_local_chunk``."""
+        if self.has_ragged():
+            raise ValueError("use ragged_local_chunk for ragged specs")
+        shape = list(self.meta.shape)
+        offs = [0] * len(shape)
+        for i, p in enumerate(self.placements):
+            if type(p) is Shard:
+                sz, off = p.local_shard_size_and_offset(shape[p.dim], self.mesh.shape[i], coord[i])
+                shape[p.dim] = sz
+                offs[p.dim] += off
+            elif isinstance(p, InterleavedShard):
+                raise ValueError("InterleavedShard local chunk is strided; use interleaved_local_slices")
+        return tuple(shape), tuple(offs)
+
+    def ragged_local_chunk(self, coord: Sequence[int]) -> Tuple[int, int]:
+        """(flat_size, flat_offset) of the ragged chunk owned at ``coord``."""
+        lay = self.layout()
+        rj, rp = lay.ragged
+        total = _prod(self.meta.shape)
+        sizes, offs = rp.local_sizes_and_offsets(total)
+        r = coord[rj]
+        if lay.ragged_inner_shard is not None:
+            a = coord[lay.ragged_inner_shard]
+            cell = sizes[r] // self.mesh.shape[lay.ragged_inner_shard]
+            return cell, offs[r] + a * cell
+        return sizes[r], offs[r]
+
+    def interleaved_local_slices(self, coord: Sequence[int]):
+        """For InterleavedShard dims: list of (dim, [(offset, size), ...])
+        describing the strided global slices owned at ``coord``."""
+        out = []
+        for i, p in enumerate(self.placements):
+            if isinstance(p, InterleavedShard):
+                n = self.mesh.shape[i]
+                r = coord[i]
+                sec = self.meta.shape[p.dim] // p.interleaved_size
+                chunk = sec // n
+                out.append((p.dim, [(j * sec + r * chunk, chunk) for j in range(p.interleaved_size)]))
+        return out
