@@ -1,0 +1,111 @@
+"""Benchmark — prints ONE JSON line for the driver.
+
+Measures nanoGPT (GPT-2-124M config) train-step throughput + MFU on the
+available chip(s).  The reference publishes no absolute numbers
+(BASELINE.md); the target ladder's north star is MFU >= 45%, so
+``vs_baseline`` reports MFU / 0.45.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    plat = device.platform.lower()
+    if "v5p" in kind:
+        return 459e12
+    if "v5" in kind or "v5e" in kind or "lite" in kind:
+        return 197e12  # v5e bf16
+    if "v4" in kind:
+        return 275e12
+    if plat == "tpu":
+        return 197e12
+    return 1e12  # CPU fallback so the line still prints
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.dmodule import parallelize_module
+    from vescale_tpu.models.nanogpt import GPT, GPTConfig, cross_entropy_loss, nanogpt_plan
+    from vescale_tpu.train import make_train_step
+
+    devices = jax.devices()
+    n = len(devices)
+    on_tpu = devices[0].platform == "tpu"
+
+    B, T = (8, 1024) if on_tpu else (2, 128)
+    cfg = GPTConfig(
+        block_size=T,
+        vocab_size=50304,
+        n_layer=12,
+        n_head=12,
+        n_embd=768,
+        dropout=0.0,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    if not on_tpu:
+        cfg = GPTConfig(block_size=T, vocab_size=512, n_layer=2, n_head=4, n_embd=128)
+
+    mesh = DeviceMesh(("dp", "tp"), (n, 1), devices=devices)
+    model = GPT(cfg)
+    dm = parallelize_module(model, mesh, nanogpt_plan(mesh, sequence_parallel=False))
+    variables = dm.init(jax.random.key(0), jnp.ones((2, T), jnp.int32))
+    params = variables["params"]
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+
+    def loss_fn(logits, batch):
+        return cross_entropy_loss(logits, batch["target"])
+
+    step = make_train_step(dm, tx, loss_fn, donate=True)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B * n, T + 1)), jnp.int32)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+
+    # warmup / compile (host-fetch the loss: on the axon tunnel
+    # block_until_ready alone does not force execution)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, batch)
+        float(loss)
+
+    iters = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, batch)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_step = B * n * T
+    tok_s_chip = tokens_per_step / dt / n
+    # PaLM-style MFU: 6*P per token + attention 12*L*T*E per token (fwd+bwd)
+    flops_per_token = 6.0 * n_params + 12.0 * cfg.n_layer * T * cfg.n_embd
+    mfu = flops_per_token * tokens_per_step / dt / (peak_flops_per_chip(devices[0]) * n)
+
+    print(
+        json.dumps(
+            {
+                "metric": "nanogpt124m_train_MFU_1chip" if on_tpu else "nanogpt_cpu_smoke_MFU",
+                "value": round(mfu, 4),
+                "unit": "MFU",
+                "vs_baseline": round(mfu / 0.45, 4),
+                "tokens_per_sec_per_chip": round(tok_s_chip, 1),
+                "step_time_ms": round(dt * 1e3, 2),
+                "params": n_params,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -204,3 +204,56 @@ def test_redistribute_local_tensor_guard(mesh1d):
     locals_ = [np.arange(r * 2, r * 2 + 2, dtype=np.float32) for r in range(8)]
     out = vt.redistribute_local_tensor(locals_, src, dst)
     np.testing.assert_array_equal(np.asarray(out), np.arange(16, dtype=np.float32))
+
+
+def test_from_local_nested_shard_roundtrip():
+    # regression: from_local shape inference with two mesh dims on one tensor dim
+    mesh = vt.DeviceMesh(("a", "b"), (2, 2))
+    x = np.arange(16, dtype=np.float32).reshape(8, 2)
+    d = vt.distribute_tensor(x, mesh, [Shard(0), Shard(0)])
+    locals_ = [np.asarray(d.to_local(rank=r)) for r in range(4)]
+    d2 = vt.from_local(locals_, mesh, [Shard(0), Shard(0)])
+    assert d2.shape == (8, 2)
+    np.testing.assert_array_equal(np.asarray(d2.full_tensor()), x)
+
+
+def test_elementwise_shape_mismatch_rejected(mesh1d):
+    a = vt.distribute_tensor(np.ones((8,), np.float32), mesh1d, [Replicate()])
+    b = vt.distribute_tensor(np.ones((4, 8), np.float32), mesh1d, [Replicate()])
+    with pytest.raises(ValueError):
+        _ = a + b
+    with pytest.raises(ValueError):
+        _ = a + np.ones((4, 8), np.float32)
+
+
+def test_all_gather_interleaved():
+    mesh = vt.DeviceMesh(("tp",), (4,))
+    x = np.arange(24, dtype=np.float32)
+    d = vt.distribute_tensor(x, mesh, [InterleavedShard(0, 3)])
+    g = vt.vescale_all_gather(d)
+    assert g.placements == (Replicate(),)
+    np.testing.assert_array_equal(np.asarray(g.to_local()), x)
+
+
+def test_negative_interleaved_dim():
+    mesh = vt.DeviceMesh(("tp",), (4,))
+    x = np.arange(24, dtype=np.float32).reshape(2, 12)
+    d = vt.distribute_tensor(x, mesh, [InterleavedShard(-1, 3)])
+    assert d.placements == (InterleavedShard(1, 3),)
+    np.testing.assert_array_equal(np.asarray(d.full_tensor()), x)
+
+
+def test_interleaved_local_slices_ceil():
+    mesh = vt.DeviceMesh(("tp",), (8,))
+    from vescale_tpu.spec import DArraySpec, TensorMeta
+
+    spec = DArraySpec(mesh, [InterleavedShard(0, 3)], TensorMeta((12,), jnp.float32))
+    # section=4 over 8 ranks: ceil chunk 1, ranks 0-3 get one element each
+    assert spec.interleaved_local_slices((0,)) == [(0, [(0, 1), (4, 1), (8, 1)])]
+    assert spec.interleaved_local_slices((5,))[0][1][0][1] == 0  # empty
+
+
+def test_reduce_scatter_dim_count_mismatch(mesh2d):
+    p = vt.from_local([np.ones((8, 2), np.float32)] * 8, mesh2d, [Partial(), Partial()])
+    with pytest.raises(ValueError):
+        vt.vescale_reduce_scatter(p, scatter_dim=[0], mesh_dims=["dp", "tp"])

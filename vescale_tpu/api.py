@@ -28,7 +28,7 @@ def vescale_all_gather(darr: DArray, mesh_dims=None) -> DArray:
     """Shard -> Replicate on the given mesh dims (api.py:314)."""
     new = list(darr.placements)
     for i in _dims(mesh_dims, darr.mesh):
-        if new[i].is_shard() or new[i].is_ragged_shard():
+        if new[i].is_shard() or new[i].is_ragged_shard() or new[i].is_interleaved_shard():
             new[i] = Replicate()
     return redistribute(darr, new)
 
@@ -52,6 +52,8 @@ def vescale_reduce_scatter(darr: DArray, scatter_dim: Union[int, Sequence[int]] 
     """Partial -> Shard(scatter_dim) on the given mesh dims (api.py:388)."""
     dims = _dims(mesh_dims, darr.mesh)
     sdims = [scatter_dim] * len(dims) if isinstance(scatter_dim, int) else list(scatter_dim)
+    if len(sdims) != len(dims):
+        raise ValueError(f"{len(sdims)} scatter dims for {len(dims)} mesh dims")
     new = list(darr.placements)
     for i, sd in zip(dims, sdims):
         if new[i].is_partial():

@@ -146,10 +146,10 @@ class DArray:
     def _elementwise(self, other, op, reverse=False):
         partial_ops = self._partial_ops()
         if isinstance(other, DArray):
-            if other._spec.placements != self._spec.placements or other.mesh != self.mesh:
+            if other._spec != self._spec:
                 raise ValueError(
-                    f"eager elementwise op requires matching placements; "
-                    f"got {self.placements} vs {other.placements} — redistribute first"
+                    f"eager elementwise op requires matching specs; "
+                    f"got {self._spec} vs {other._spec} — redistribute first"
                 )
             if partial_ops and (op is not jnp.add or any(o not in ("sum",) for o in partial_ops)):
                 raise ValueError("only + over Partial(sum) operands is linear")
@@ -162,7 +162,13 @@ class DArray:
             a, b = self._data, other
         if reverse:
             a, b = b, a
-        return DArray(op(a, b), self._spec)
+        out = op(a, b)
+        if tuple(out.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"elementwise result shape {out.shape} != physical shape "
+                f"{self._data.shape}; broadcasting against a DArray is not supported eagerly"
+            )
+        return DArray(out, self._spec)
 
     def __add__(self, o):
         return self._elementwise(o, jnp.add)
@@ -303,19 +309,29 @@ def from_local(
 
     # infer logical global shape from locals
     if shape is None:
+        import itertools
+
         r0 = locals_[0]
         gshape = list(r0.shape)
+        # group mesh dims by the tensor dim they shard (nested chunking:
+        # total = sum of local sizes over the cartesian product of the
+        # sharding mesh dims, other coords held at 0)
+        shard_dims_of: dict = {}
         for i, p in enumerate(placements):
             if type(p) is Shard:
-                # sum local sizes walking ranks along mesh dim i at zero-coords
-                total = 0
-                for r in range(device_mesh.shape[i]):
-                    coord = [0] * device_mesh.ndim
+                shard_dims_of.setdefault(p.dim, []).append(i)
+        for d, mesh_dims in shard_dims_of.items():
+            sizes = [device_mesh.shape[i] for i in mesh_dims]
+            total = 0
+            for idx in itertools.product(*(range(n) for n in sizes)):
+                coord = [0] * device_mesh.ndim
+                for i, r in zip(mesh_dims, idx):
                     coord[i] = r
-                    flat = int(np.ravel_multi_index(coord, device_mesh.shape))
-                    total += locals_[flat].shape[p.dim]
-                gshape[p.dim] = total
-            elif isinstance(p, InterleavedShard):
+                flat = int(np.ravel_multi_index(coord, device_mesh.shape))
+                total += locals_[flat].shape[d]
+            gshape[d] = total
+        for i, p in enumerate(placements):
+            if isinstance(p, InterleavedShard):
                 gshape[p.dim] = r0.shape[p.dim] * device_mesh.shape[i]
             elif isinstance(p, RaggedShard):
                 total = 0

@@ -1,0 +1,1 @@
+from .api import parallelize_module, DModule, PlacementsInterface, pspec_of

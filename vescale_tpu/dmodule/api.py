@@ -1,0 +1,212 @@
+"""DModule — plan-driven TP/SP parallelization of flax modules.
+
+Capability parity with the reference DModule (legacy/vescale/dmodule/):
+  - ``parallelize_module(module, mesh, {"parameter": ..., "forward": ...})``
+    <- dmodule/api.py:33
+  - FQN-regex param plans -> param shardings  <- _dmodule.py:133,217
+  - forward input/output resharding at module boundaries <- _hook.py:76-259
+  - deferred init / materialize only the local shard <- initialize/deferred_init.py
+
+TPU-native design: instead of per-module pre/post hooks issuing NCCL calls,
+the plan lowers to
+
+  * ``NamedSharding`` for every parameter (applied at init via jit
+    ``out_shardings`` — parameters materialize *already sharded*, the
+    deferred-init story, with no torchdistX patch), and
+  * ``jax.lax.with_sharding_constraint`` at module boundaries via a flax
+    method interceptor (the forward plan).  XLA inserts the collectives the
+    reference's hooks performed (all-gather at TP boundaries, the SP
+    Shard(seq) <-> Replicate transitions, grad psum in backward — the
+    _grad_sync.py machinery is implicit in GSPMD's reverse-mode).
+
+The sharding-plan *format* mirrors the reference examples
+(e.g. legacy/examples/nanogpt_4D_finetune/sharding_plan.py): regex FQNs ->
+placements.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+import flax.linen as nn
+
+from ..mesh import DeviceMesh
+from ..placements import Placement, Replicate, Shard, normalize_placements
+from ..spec import DArraySpec, TensorMeta
+
+__all__ = ["parallelize_module", "DModule", "PlacementsInterface", "pspec_of"]
+
+
+def pspec_of(placements, ndim: int, mesh: DeviceMesh) -> PartitionSpec:
+    """Lower placements to a logical PartitionSpec (Partial -> no constraint
+    on that mesh dim; XLA tracks partial sums itself)."""
+    placements = normalize_placements(placements, mesh.ndim, ndim)
+    names: List[List[str]] = [[] for _ in range(ndim)]
+    for i, p in enumerate(placements):
+        if type(p) is Shard:
+            names[p.dim].append(mesh.dim_name(i))
+    return PartitionSpec(*(None if not ns else (ns[0] if len(ns) == 1 else tuple(ns)) for ns in names))
+
+
+@dataclasses.dataclass
+class PlacementsInterface:
+    """Input/output resharding hints for one module
+    (reference dmodule/placements_interface.py)."""
+
+    input: Optional[Sequence] = None   # per positional arg: placements | None
+    output: Optional[Sequence] = None  # per output leaf: placements | None
+
+    @classmethod
+    def normalize(cls, v) -> "PlacementsInterface":
+        if isinstance(v, PlacementsInterface):
+            return v
+        if isinstance(v, dict):
+            return cls(input=v.get("input"), output=v.get("output"))
+        # bare list == input placements
+        return cls(input=v)
+
+
+def _match(plan: Dict[str, Any], fqn: str):
+    for pattern, v in plan.items():
+        if re.fullmatch(pattern, fqn):
+            return v
+    return None
+
+
+def _constrain(x, placements, mesh: DeviceMesh):
+    if placements is None or not isinstance(x, (jax.Array, jnp.ndarray)) or np.isscalar(x):
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh.jax_mesh, pspec_of(placements, x.ndim, mesh)))
+
+
+def _constrain_tree(tree, placements_list, mesh: DeviceMesh):
+    leaves = tree if isinstance(tree, (tuple, list)) else (tree,)
+    if placements_list is None:
+        return tree
+    # one placements entry per leaf; a single entry broadcasts
+    pl = list(placements_list)
+    if len(pl) == 1 and len(leaves) > 1:
+        pl = pl * len(leaves)
+    out = [
+        _constrain(leaf, p, mesh) if p is not None else leaf
+        for leaf, p in zip(leaves, pl + [None] * (len(leaves) - len(pl)))
+    ]
+    if isinstance(tree, tuple):
+        return tuple(out)
+    if isinstance(tree, list):
+        return out
+    return out[0]
+
+
+class DModule:
+    """A flax module bound to a mesh + sharding plan.
+
+    Usage (mirrors reference dmodule/api.py:33):
+
+        dmodel = parallelize_module(model, mesh, {"parameter": PARAM_PLAN,
+                                                  "forward": FWD_PLAN})
+        variables = dmodel.init(key, x)        # params born sharded
+        out = dmodel.apply(variables, x)       # boundary resharding applied
+    """
+
+    def __init__(self, module: nn.Module, device_mesh: DeviceMesh, sharding_plan: Dict[str, Any]):
+        self.module = module
+        self.mesh = device_mesh
+        plan = sharding_plan or {}
+        self.param_plan: Dict[str, Any] = dict(plan.get("parameter", {}))
+        self.fwd_plan: Dict[str, PlacementsInterface] = {
+            k: PlacementsInterface.normalize(v) for k, v in dict(plan.get("forward", {})).items()
+        }
+        self.default_input_placements = plan.get("default_input", None)
+
+    # --------------------------------------------------------- param plans
+    def param_placements(self, path: str, ndim: int) -> Tuple[Placement, ...]:
+        v = _match(self.param_plan, path)
+        return normalize_placements(v, self.mesh.ndim, ndim)
+
+    def _path_str(self, keypath) -> str:
+        parts = []
+        for k in keypath:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        # drop the leading collection name ("params")
+        return ".".join(parts[1:]) if len(parts) > 1 else ".".join(parts)
+
+    def variables_shardings(self, abstract_variables):
+        """Tree of NamedSharding for a variables pytree (params sharded per
+        plan; other collections replicated)."""
+
+        def one(keypath, leaf):
+            path = self._path_str(keypath)
+            coll = str(keypath[0].key) if hasattr(keypath[0], "key") else ""
+            if coll != "params":
+                return NamedSharding(self.mesh.jax_mesh, PartitionSpec())
+            pl = self.param_placements(path, len(leaf.shape))
+            return NamedSharding(self.mesh.jax_mesh, pspec_of(pl, len(leaf.shape), self.mesh))
+
+        return jax.tree_util.tree_map_with_path(one, abstract_variables)
+
+    def param_specs(self, variables):
+        """Tree of DArraySpec for the params (used by optimizer/checkpoint)."""
+
+        def one(keypath, leaf):
+            path = self._path_str(keypath)
+            pl = self.param_placements(path, len(leaf.shape))
+            return DArraySpec(self.mesh, pl, TensorMeta(tuple(leaf.shape), leaf.dtype))
+
+        return jax.tree_util.tree_map_with_path(one, variables)
+
+    # ------------------------------------------------------------ init
+    def init(self, rngs, *args, **kwargs):
+        """Deferred + sharded init: trace init abstractly (eval_shape — the
+        torchdistX-free deferred init), compute param shardings from the
+        plan, then materialize each shard on its own devices via jit
+        out_shardings (reference materialize_dtensor semantics)."""
+        abstract = jax.eval_shape(lambda r: self.module.init(r, *args, **kwargs), rngs)
+        shardings = self.variables_shardings(abstract)
+        init_fn = jax.jit(
+            lambda r: self.module.init(r, *args, **kwargs), out_shardings=shardings
+        )
+        return init_fn(rngs)
+
+    # ------------------------------------------------------------ apply
+    def _interceptor(self, next_fun, args, kwargs, context):
+        if context.method_name != "__call__":
+            return next_fun(*args, **kwargs)
+        fqn = ".".join(context.module.path)
+        pi = _match(self.fwd_plan, fqn)
+        if pi is None:
+            return next_fun(*args, **kwargs)
+        if pi.input is not None:
+            args = tuple(_constrain_tree(list(args), pi.input, self.mesh))
+        out = next_fun(*args, **kwargs)
+        if pi.output is not None:
+            out = _constrain_tree(out, pi.output, self.mesh)
+        return out
+
+    def apply(self, variables, *args, **kwargs):
+        with nn.intercept_methods(self._interceptor):
+            return self.module.apply(variables, *args, **kwargs)
+
+    def __call__(self, variables, *args, **kwargs):
+        return self.apply(variables, *args, **kwargs)
+
+
+def parallelize_module(
+    module: nn.Module,
+    device_mesh: DeviceMesh,
+    sharding_plan: Optional[Dict[str, Any]] = None,
+) -> DModule:
+    """Reference dmodule/api.py:33 — wrap a module with a sharding plan."""
+    return DModule(module, device_mesh, sharding_plan or {})

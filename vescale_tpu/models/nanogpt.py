@@ -1,0 +1,154 @@
+"""nanoGPT — the first rung of the model ladder.
+
+Mirrors the reference example model (legacy/examples/nanogpt_4D_finetune/
+model.py — a GPT-2-style decoder) re-written as an idiomatic flax module,
+with the 4D sharding plan of
+legacy/examples/nanogpt_4D_finetune/sharding_plan.py expressed as
+vescale_tpu plan dicts (TP/SP over the "tp" mesh dim, DP over "dp").
+
+TPU notes: matmuls stay in bf16-friendly shapes; attention uses a fused
+softmax(QK^T)V formulation XLA maps onto the MXU; dropout uses the
+shard-aware deterministic RNG (bitwise single-device-equal masks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..placements import Replicate, Shard
+
+__all__ = ["GPTConfig", "GPT", "nanogpt_plan", "cross_entropy_loss"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    block_size: int = 1024
+    vocab_size: int = 50304  # padded to a multiple of 64 (MXU-friendly)
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    dropout: float = 0.0
+    bias: bool = True
+    dtype: Any = jnp.float32
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        c = self.config
+        B, T, E = x.shape
+        H = c.n_head
+        qkv = nn.Dense(3 * E, use_bias=c.bias, dtype=c.dtype, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, E // H)
+        k = k.reshape(B, T, H, E // H)
+        v = v.reshape(B, T, H, E // H)
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(E // H)
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        att = jnp.where(mask[None, None, :, :], att, jnp.finfo(att.dtype).min)
+        att = jax.nn.softmax(att, axis=-1)
+        att = nn.Dropout(c.dropout, deterministic=deterministic)(att)
+        y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, E)
+        y = nn.Dense(E, use_bias=c.bias, dtype=c.dtype, name="c_proj")(y)
+        return nn.Dropout(c.dropout, deterministic=deterministic)(y)
+
+
+class MLP(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        c = self.config
+        x = nn.Dense(4 * c.n_embd, use_bias=c.bias, dtype=c.dtype, name="c_fc")(x)
+        x = nn.gelu(x)
+        x = nn.Dense(c.n_embd, use_bias=c.bias, dtype=c.dtype, name="c_proj")(x)
+        return nn.Dropout(c.dropout, deterministic=deterministic)(x)
+
+
+class Block(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        c = self.config
+        x = x + CausalSelfAttention(c, name="attn")(
+            nn.LayerNorm(use_bias=c.bias, dtype=c.dtype, name="ln_1")(x), deterministic
+        )
+        x = x + MLP(c, name="mlp")(
+            nn.LayerNorm(use_bias=c.bias, dtype=c.dtype, name="ln_2")(x), deterministic
+        )
+        return x
+
+
+class GPT(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, idx, deterministic: bool = True):
+        c = self.config
+        B, T = idx.shape
+        wte = nn.Embed(c.vocab_size, c.n_embd, dtype=c.dtype, name="wte")
+        wpe = nn.Embed(c.block_size, c.n_embd, dtype=c.dtype, name="wpe")
+        pos = jnp.arange(T)[None, :]
+        x = wte(idx) + wpe(pos)
+        x = nn.Dropout(c.dropout, deterministic=deterministic)(x)
+        for i in range(c.n_layer):
+            x = Block(c, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(use_bias=c.bias, dtype=c.dtype, name="ln_f")(x)
+        # weight-tied LM head (reference model.py ties wte/lm_head)
+        logits = wte.attend(x)
+        return logits
+
+
+def cross_entropy_loss(logits, targets):
+    """Token-level cross entropy, fp32 accumulation."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def nanogpt_plan(mesh, sequence_parallel: bool = True):
+    """TP/SP sharding plan over mesh dims ("dp", "tp")
+    (reference legacy/examples/nanogpt_4D_finetune/sharding_plan.py:23-70).
+
+    Param plan: column-parallel c_attn/c_fc, row-parallel c_proj,
+    hidden-sharded embeddings; LayerNorms replicated.
+    Forward plan: batch DP-sharded everywhere; inside blocks the LN regions
+    run sequence-parallel (activations Shard(1) on seq over tp) and
+    attn/mlp regions run tensor-parallel (activations gathered on seq).
+    """
+    R, S = Replicate(), Shard
+    dp_only = [S(0), R]  # activations (B, T, E): batch over dp
+    seq_par = [S(0), S(1)] if sequence_parallel else dp_only
+    param_plan = {
+        r"wte\.embedding": [R, S(1)],
+        r"wpe\.embedding": [R, S(1)],
+        r"h_\d+\.attn\.c_attn\.kernel": [R, S(1)],
+        r"h_\d+\.attn\.c_attn\.bias": [R, S(0)],
+        r"h_\d+\.attn\.c_proj\.kernel": [R, S(0)],
+        r"h_\d+\.attn\.c_proj\.bias": [R, R],
+        r"h_\d+\.mlp\.c_fc\.kernel": [R, S(1)],
+        r"h_\d+\.mlp\.c_fc\.bias": [R, S(0)],
+        r"h_\d+\.mlp\.c_proj\.kernel": [R, S(0)],
+        r"h_\d+\.mlp\.c_proj\.bias": [R, R],
+        # LayerNorm scales/biases replicated (grads Partial-synced by GSPMD)
+        r".*ln_\d*\.(scale|bias)": [R, R],
+        r".*": [R, R],
+    }
+    fwd_plan = {
+        r"": {"input": [dp_only], "output": [dp_only]},
+        r"h_\d+\.ln_[12]": {"input": [seq_par], "output": [seq_par]},
+        r"h_\d+\.attn": {"input": [dp_only], "output": [dp_only]},
+        r"h_\d+\.mlp": {"input": [dp_only], "output": [dp_only]},
+        r"ln_f": {"input": [seq_par], "output": [dp_only]},
+    }
+    return {"parameter": param_plan, "forward": fwd_plan}

@@ -254,7 +254,7 @@ def normalize_placement(p, ndim: Optional[int] = None) -> Placement:
     """Accept shorthand: int -> Shard(int), "replicate"/"r" -> Replicate(),
     "partial" -> Partial(); negative Shard dims normalized given ndim."""
     if isinstance(p, Placement):
-        if ndim is not None and isinstance(p, Shard) and p.dim < 0:
+        if ndim is not None and isinstance(p, (Shard, InterleavedShard)) and p.dim < 0:
             return dataclasses.replace(p, dim=p.dim + ndim)
         return p
     if isinstance(p, int):

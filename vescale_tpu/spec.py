@@ -507,6 +507,7 @@ class DArraySpec:
                 n = self.mesh.shape[i]
                 r = coord[i]
                 sec = self.meta.shape[p.dim] // p.interleaved_size
-                chunk = sec // n
-                out.append((p.dim, [(j * sec + r * chunk, chunk) for j in range(p.interleaved_size)]))
+                # ceil-division chunking, matching the layout/to_local math
+                ext, off = nested_chunk(sec, [n], [r])
+                out.append((p.dim, [(j * sec + off, ext) for j in range(p.interleaved_size)]))
         return out

@@ -1,0 +1,73 @@
+"""Jitted train-step assembly.
+
+The reference's training loop composes DModule forward + DDP backward +
+DistributedOptimizer step as three separately-hooked eager phases (SURVEY
+§3.3).  TPU-native, the whole step is ONE jit-compiled program: GSPMD
+inserts the DP grad all-reduce, TP boundary collectives and ZeRO
+reduce-scatter/all-gather, and XLA's latency-hiding scheduler overlaps them
+with compute (the role of the reference's async bucket machinery).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .dmodule.api import DModule
+
+__all__ = ["make_train_step", "make_eval_step"]
+
+
+def make_train_step(
+    dmodel: DModule,
+    tx: optax.GradientTransformation,
+    loss_fn: Callable,
+    *,
+    has_aux: bool = False,
+    donate: bool = True,
+    rng_streams: tuple = ("dropout",),
+):
+    """Build ``train_step(params, opt_state, batch, step_key) ->
+    (params, opt_state, loss)``.
+
+    ``loss_fn(logits_or_outputs, batch)`` computes the scalar loss from the
+    model output.  Dropout etc. draw from ``step_key`` folded per stream —
+    deterministic and bitwise-identical under any sharding.
+    """
+
+    def step(params, opt_state, batch, step_key=None):
+        def compute_loss(p):
+            rngs = (
+                {name: jax.random.fold_in(step_key, i) for i, name in enumerate(rng_streams)}
+                if step_key is not None
+                else None
+            )
+            deterministic = step_key is None
+            out = dmodel.apply(
+                {"params": p}, batch["input"], deterministic=deterministic, rngs=rngs
+            )
+            return loss_fn(out, batch)
+
+        if has_aux:
+            (loss, aux), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+        else:
+            loss, grads = jax.value_and_grad(compute_loss)(params)
+            aux = None
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        if has_aux:
+            return new_params, new_opt_state, loss, aux
+        return new_params, new_opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_eval_step(dmodel: DModule, loss_fn: Callable):
+    def step(params, batch):
+        out = dmodel.apply({"params": params}, batch["input"], deterministic=True)
+        return loss_fn(out, batch)
+
+    return jax.jit(step)
