@@ -1,0 +1,9 @@
+from .ddp import DistributedDataParallel
+from .optimizer import (
+    BasicOptimizer,
+    DistributedOptimizer,
+    zero_sharded,
+    clip_grad_norm_fp32,
+    muon,
+)
+from .fsdp import FSDPParamBuffer, fsdp_plan

@@ -1,0 +1,112 @@
+"""DDP — data parallelism over a mesh dim.
+
+Capability parity with the reference DistributedDataParallel
+(legacy/vescale/ddp/distributed_data_parallel.py:20) and its GradBuffer
+(ddp/grad_buffer.py:226): flattened dtype-grouped grad buffers, bucketed
+async all-reduce or reduce-scatter, main_grad fp32 accumulation.
+
+TPU-native design: under jit, DP gradient reduction is *structural* — the
+batch is Shard(dp), params are Replicate(dp), so reverse-mode GSPMD emits the
+grad all-reduce (or reduce-scatter when the optimizer states are
+dp-sharded, see optimizer.py), and XLA's latency-hiding scheduler overlaps it
+with remaining backward compute — the role of the reference's bucket
+machinery.  What remains here:
+
+  * the user-facing wrapper (module + data sharding contract),
+  * fp32 ``main_grad`` accumulation across micro-batches,
+  * an explicit eager ``finish_grad_sync`` for non-jit flows (DArray psum).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..dmodule.api import DModule
+from ..mesh import DeviceMesh
+from ..placements import Partial, Replicate, Shard
+
+__all__ = ["DistributedDataParallel"]
+
+
+class DistributedDataParallel:
+    """Wraps a DModule for data parallelism on ``dp_dim``.
+
+    Mirrors the reference constructor surface (data_pg_or_device_mesh,
+    accumulate_allreduce_grads_in_fp32, overlap_grad_reduce,
+    use_distributed_optimizer); on TPU overlap flags are advisory (XLA
+    schedules overlap) and kept for migration compatibility.
+    """
+
+    def __init__(
+        self,
+        module: DModule,
+        data_pg_or_device_mesh: Optional[DeviceMesh] = None,
+        dp_dim: str = "dp",
+        accumulate_allreduce_grads_in_fp32: bool = True,
+        overlap_grad_reduce: bool = True,
+        use_distributed_optimizer: bool = False,
+        disable_bucketing: bool = False,
+        bucket_size: int = 40000000,
+        **_: Any,
+    ) -> None:
+        self.module = module
+        self.mesh = data_pg_or_device_mesh or module.mesh
+        self.dp_dim = dp_dim
+        self.accumulate_in_fp32 = accumulate_allreduce_grads_in_fp32
+        self.use_distributed_optimizer = use_distributed_optimizer
+
+    # ------------------------------------------------------------- apply
+    def apply(self, variables, *args, **kwargs):
+        return self.module.apply(variables, *args, **kwargs)
+
+    __call__ = apply
+
+    def shard_batch(self, batch):
+        """Attach the DP sharding to a batch pytree (batch dim 0)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sh = NamedSharding(self.mesh.jax_mesh, PartitionSpec(self.dp_dim))
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
+
+    # ----------------------------------------------------- grad handling
+    def init_main_grads(self, params):
+        """fp32 zero grad accumulators (the reference's flattened fp32
+        GradBuffer, ddp/grad_buffer.py:226 — unflattened here; XLA fuses)."""
+        dt = jnp.float32 if self.accumulate_in_fp32 else None
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, dt or p.dtype), params
+        )
+
+    def accumulate_grads(self, main_grads, micro_grads):
+        """main_grad += micro_grad (fp32), jit-friendly."""
+        return jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(a.dtype), main_grads, micro_grads
+        )
+
+    def scale_grads(self, main_grads, num_micro: int):
+        return jax.tree_util.tree_map(lambda g: g / num_micro, main_grads)
+
+    def finish_grad_sync(self, grads):
+        """Eager DP grad sync for non-jit flows (reference finish_grad_sync,
+        distributed_data_parallel.py:289): DArray leaves with a Partial
+        placement on the dp dim are all-reduced (or reduce-scattered when
+        ``use_distributed_optimizer``, matching the reference's
+        grad_buffer.py:114-150 switch).  Plain-array leaves are already
+        global values in the single-controller model — returned unchanged."""
+        from ..darray import DArray
+
+        dp_index = self.mesh._dim_index(self.dp_dim)
+
+        def one(g):
+            if isinstance(g, DArray) and g.placements[dp_index].is_partial():
+                new = list(g.placements)
+                new[dp_index] = Shard(0) if self.use_distributed_optimizer else Replicate()
+                return g.redistribute(placements=new)
+            return g
+
+        return jax.tree_util.tree_map(
+            one, grads, is_leaf=lambda x: isinstance(x, DArray)
+        )

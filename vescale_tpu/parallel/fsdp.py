@@ -1,0 +1,228 @@
+"""veScale-FSDP — ragged flat param buffers (ZeRO-3).
+
+Capability parity with the new-gen veScale FSDP (vescale/dtensor/
+placement_types.py:46 RaggedShard, docs/texts/raggedshard.md, veScale-FSDP
+paper arXiv:2602.22437): all params flattened into one flat buffer whose
+shard boundaries fall exactly on param boundaries (ragged units), giving
+
+  * ONE batched all-gather for all params / ONE reduce-scatter for all grads
+    per step (zero-copy batched collectives), and
+  * communication-free checkpoint: every param chunk lives wholly on one
+    rank (see checkpoint/).
+
+TPU-native: the buffer is a DArray with a ``RaggedShard`` placement — padded
+rank-major physical layout (spec.py) so XLA sees an even Shard(0).  The
+gather is an all-gather of the padded buffer + static slices; the grad
+reduce-scatter is a sharding constraint on the packed grads.  Optimizer
+state lives as flat buffers with the same ragged sharding (the reference's
+gbuf range maps collapse into the layout algebra).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..mesh import DeviceMesh
+from ..placements import RaggedShard, Replicate, Shard
+from ..spec import DArraySpec, TensorMeta
+
+__all__ = ["FSDPParamBuffer", "fsdp_plan", "make_fsdp_train_step"]
+
+
+def fsdp_plan(abstract_params, mesh: DeviceMesh, dim: str = "dp") -> Dict[str, Any]:
+    """Per-param GSPMD FSDP plan: shard each param's largest divisible dim
+    over ``dim`` (the simple non-ragged FSDP; use FSDPParamBuffer for the
+    ragged batched-collective form)."""
+    n = mesh.size(dim)
+    di = mesh._dim_index(dim)
+    plan: Dict[str, Any] = {}
+
+    def one(keypath, leaf):
+        parts = [str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath]
+        # drop a leading variable-collection key if present; DModule FQNs
+        # (dmodule/api.py _path_str) never include it
+        if parts and parts[0] in ("params", "batch_stats", "cache"):
+            parts = parts[1:]
+        path = ".".join(parts)
+        best = None
+        for d in sorted(range(len(leaf.shape)), key=lambda d: -leaf.shape[d]):
+            if leaf.shape[d] % n == 0 and leaf.shape[d] >= n:
+                best = d
+                break
+        placements = [Replicate()] * mesh.ndim
+        if best is not None:
+            placements[di] = Shard(best)
+        plan[re.escape(path)] = placements
+        return leaf
+
+    jax.tree_util.tree_map_with_path(one, abstract_params)
+    return plan
+
+
+class _DtypeGroup:
+    """One flat ragged buffer: all params of one dtype."""
+
+    def __init__(self, indices, shapes, sizes, dtype, mesh, dim_index, n):
+        self.indices = indices      # positions in the flattened params list
+        self.shapes = shapes
+        self.sizes = sizes
+        self.dtype = dtype
+        self.offsets = list(np.cumsum([0] + sizes[:-1]))
+        self.total = int(sum(sizes))
+        self.local_units = self._balanced_units(n)
+        placements = [Replicate()] * mesh.ndim
+        placements[dim_index] = RaggedShard((0,), self.local_units)
+        self.spec = DArraySpec(mesh, placements, TensorMeta((self.total,), dtype))
+
+    def _balanced_units(self, n: int) -> Tuple[int, ...]:
+        """Greedy contiguous partition of params into n rank groups balancing
+        element counts (reference build_gbuf_range / allocator balance).
+        Boundaries fall on param boundaries; ranks may be empty."""
+        target = self.total / n
+        units = [0] * n
+        r, consumed = 0, 0
+        for s in self.sizes:
+            while r < n - 1 and consumed >= target * (r + 1):
+                r += 1
+            units[r] += s
+            consumed += s
+        assert sum(units) == self.total, (units, self.total)
+        return tuple(units)
+
+
+class FSDPParamBuffer:
+    """Flat ragged buffers over all params, one per dtype group (reference
+    GradBuffer dtype grouping, ddp/grad_buffer.py:226).
+
+    ``abstract_params``: pytree of ShapeDtypeStruct/arrays (shapes only are
+    used).  ``dim``: the mesh dim to shard over.  Unit granularity is one
+    element, so shard boundaries sit exactly at the greedy-balanced param
+    boundaries (reference MoE/FSDP unit semantics with unit_size=1).
+
+    ``pack`` returns a dict {dtype_name: physical_buffer} — a pytree, so it
+    flows through jit/optax directly.
+    """
+
+    def __init__(self, abstract_params, mesh: DeviceMesh, dim: str = "dp"):
+        self.mesh = mesh
+        self.dim = dim
+        self.dim_index = mesh._dim_index(dim)
+        n = mesh.size(dim)
+
+        leaves, self.treedef = jax.tree_util.tree_flatten(abstract_params)
+        self.n_leaves = len(leaves)
+        by_dtype: Dict[str, List[int]] = {}
+        for i, l in enumerate(leaves):
+            by_dtype.setdefault(jnp.dtype(l.dtype).name, []).append(i)
+        self.groups: Dict[str, _DtypeGroup] = {}
+        for name, idxs in sorted(by_dtype.items()):
+            self.groups[name] = _DtypeGroup(
+                idxs,
+                [tuple(leaves[i].shape) for i in idxs],
+                [int(np.prod(leaves[i].shape)) for i in idxs],
+                jnp.dtype(name),
+                mesh,
+                self.dim_index,
+                n,
+            )
+
+    @property
+    def local_units(self) -> Tuple[int, ...]:
+        """Summed per-rank units across dtype groups (info/balance checks)."""
+        n = self.mesh.size(self.dim)
+        return tuple(sum(g.local_units[r] for g in self.groups.values()) for r in range(n))
+
+    # ------------------------------------------------------------ packing
+    def flatten(self, params) -> Dict[str, jax.Array]:
+        """params tree -> per-dtype flat logical buffers (jit-friendly)."""
+        leaves = jax.tree_util.tree_leaves(params)
+        out = {}
+        for name, g in self.groups.items():
+            out[name] = jnp.concatenate([jnp.ravel(leaves[i]).astype(g.dtype) for i in g.indices])
+        return out
+
+    def unflatten(self, flats: Dict[str, jax.Array]):
+        """per-dtype flat buffers -> params tree (jit-friendly)."""
+        leaves = [None] * self.n_leaves
+        for name, g in self.groups.items():
+            flat = flats[name]
+            for i, off, size, shape in zip(g.indices, g.offsets, g.sizes, g.shapes):
+                leaves[i] = jax.lax.dynamic_slice(flat, (off,), (size,)).reshape(shape)
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def _attach(self, phys, spec):
+        if isinstance(phys, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(phys, spec.named_sharding())
+        return jax.device_put(phys, spec.named_sharding())
+
+    def pack(self, params) -> Dict[str, jax.Array]:
+        """params -> padded rank-major physical buffers with the ragged
+        sharding attached (ONE batched scatter/reduce-scatter per dtype)."""
+        flats = self.flatten(params)
+        return {name: self._attach(g.spec.pack(flats[name]), g.spec) for name, g in self.groups.items()}
+
+    def gather(self, physicals: Dict[str, jax.Array]):
+        """physical buffers -> params tree (ONE batched all-gather-v per
+        dtype)."""
+        return self.unflatten({name: g.spec.unpack(physicals[name]) for name, g in self.groups.items()})
+
+    def constrain(self, physicals: Dict[str, jax.Array]):
+        """Re-attach the ragged shardings to computed physical buffers."""
+        return {name: self._attach(physicals[name], g.spec) for name, g in self.groups.items()}
+
+    def local_params(self, rank: int) -> List[Tuple[int, int]]:
+        """[(param_index, intra-param offset)...] fully/partially owned by
+        ``rank`` — the communication-free checkpoint chunk map."""
+        coord = self.mesh.coordinate_of_rank(rank)
+        out = []
+        for g in self.groups.values():
+            size, off = g.spec.ragged_local_chunk(coord)
+            for i, o, s in zip(g.indices, g.offsets, g.sizes):
+                lo, hi = max(o, off), min(o + s, off + size)
+                if lo < hi:
+                    out.append((i, lo - o))
+        return out
+
+
+def make_fsdp_train_step(
+    dmodel,
+    tx: optax.GradientTransformation,
+    loss_fn: Callable,
+    buffer: FSDPParamBuffer,
+    *,
+    donate: bool = True,
+):
+    """ZeRO-3 train step over the ragged buffer:
+
+      gather params (all-gather-v) -> fwd/bwd -> pack grads (reduce-
+      scatter-v) -> optimizer update on the local flat shard -> done.
+
+    The optimizer state is flat buffers with the same ragged sharding, so
+    each chip updates only its shard (the reference's
+    build_model_and_main_param_groups range maps, distributed_optimizer.py:601).
+    """
+
+    def step(buf, opt_state, batch, step_key=None):
+        def compute_loss(b):
+            params = buffer.gather(b)
+            rngs = {"dropout": step_key} if step_key is not None else None
+            out = dmodel.apply(
+                {"params": params}, batch["input"], deterministic=step_key is None, rngs=rngs
+            )
+            return loss_fn(out, batch)
+
+        loss, gbuf = jax.value_and_grad(compute_loss)(buf)
+        gbuf = buffer.constrain(gbuf)
+        updates, opt_state = tx.update(gbuf, opt_state, buf)
+        buf = optax.apply_updates(buf, updates)
+        buf = buffer.constrain(buf)
+        return buf, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,307 @@
+"""Optimizers: BasicOptimizer, DistributedOptimizer (ZeRO-2+), Muon.
+
+Capability parity:
+  - ``BasicOptimizer``        <- legacy/vescale/optim/base_optimizer.py:116
+  - ``DistributedOptimizer``  <- legacy/vescale/optim/distributed_optimizer.py:131
+  - ``clip_grad_norm_fp32``   <- legacy/vescale/optim/clip_grads.py:21
+  - Muon-style optimizer      <- new-gen veScale (README.md:19, raggedshard.md
+                                 §Structure-Aware gather-compute-scatter)
+
+TPU-native ZeRO design: the reference maintains explicit gbuf range maps
+(distributed_optimizer.py:383-601) to give each DP rank a contiguous shard of
+grads + optimizer state, reduce-scattering grads in and all-gathering params
+out.  Under GSPMD the same state machine is expressed as *sharding
+constraints*: optimizer-state leaves (and the fp32 master params) carry a
+Shard(dp) annotation, so XLA compiles the grad reduction as reduce-scatter,
+runs the param update on 1/dp of the elements per chip, and all-gathers the
+updated params — the weight-update-sharding transform of
+arXiv:2004.13336, with overlap from the latency-hiding scheduler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..mesh import DeviceMesh
+
+__all__ = [
+    "BasicOptimizer",
+    "DistributedOptimizer",
+    "zero_sharded",
+    "clip_grad_norm_fp32",
+    "muon",
+]
+
+
+# --------------------------------------------------------------------- util
+def _zero_pspec_for(shape: Tuple[int, ...], param_pspec: PartitionSpec, mesh: DeviceMesh, dp_dims: Sequence[str]) -> PartitionSpec:
+    """Add the dp axes to the first free, divisible dim of a state leaf
+    (weight-update sharding).  Leaves too small / indivisible — or already
+    sharded on a dp axis — stay as-is."""
+    entries = list(param_pspec) + [None] * (len(shape) - len(param_pspec))
+
+    def uses_dp(e) -> bool:
+        names = e if isinstance(e, tuple) else (e,)
+        return any(n in dp_dims for n in names if n is not None)
+
+    if any(uses_dp(e) for e in entries):
+        return param_pspec  # param itself is dp-sharded (FSDP-style) already
+    dp_total = 1
+    for d in dp_dims:
+        dp_total *= mesh.size(d)
+    for i, (s, e) in enumerate(zip(shape, entries)):
+        if e is None and s % dp_total == 0 and s >= dp_total:
+            entries[i] = tuple(dp_dims) if len(dp_dims) > 1 else dp_dims[0]
+            return PartitionSpec(*entries)
+    return param_pspec
+
+
+def _state_pspec(state_kp, shape, param_paths, pspec_by_path, mesh, dp_dims) -> Optional[PartitionSpec]:
+    """ZeRO pspec for one state leaf, or None if it matches no param.
+
+    Optimizer-state trees (adam mu/nu, momentum, master params) embed the
+    params tree: a state leaf's keypath *ends with* some param's keypath.
+    Matching by keypath suffix (+ shape check) is exact where a shape-dict
+    heuristic would confuse same-shaped params with different layouts."""
+    kp = tuple(str(k) for k in state_kp)
+    for plen in range(len(kp), 0, -1):
+        suffix = kp[-plen:]
+        if suffix in param_paths and param_paths[suffix] == shape:
+            base = pspec_by_path.get(suffix, PartitionSpec())
+            return _zero_pspec_for(shape, base, mesh, dp_dims)
+    return None
+
+
+def _param_path_maps(params, param_pspecs):
+    param_paths = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        param_paths[tuple(str(k) for k in kp)] = tuple(leaf.shape)
+    pspec_by_path = {}
+    for kp, ps in jax.tree_util.tree_flatten_with_path(
+        param_pspecs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )[0]:
+        pspec_by_path[tuple(str(k) for k in kp)] = ps
+    return param_paths, pspec_by_path
+
+
+def _constrain_state(state, params, param_pspecs, mesh: DeviceMesh, dp_dims):
+    """Attach ZeRO shardings to every state leaf that corresponds to a param."""
+    param_paths, pspec_by_path = _param_path_maps(params, param_pspecs)
+
+    def one(state_kp, leaf):
+        if not hasattr(leaf, "shape") or len(leaf.shape) == 0:
+            return leaf
+        ps = _state_pspec(state_kp, tuple(leaf.shape), param_paths, pspec_by_path, mesh, dp_dims)
+        if ps is None:
+            return leaf
+        return jax.lax.with_sharding_constraint(leaf, NamedSharding(mesh.jax_mesh, ps))
+
+    return jax.tree_util.tree_map_with_path(one, state)
+
+
+def zero_sharded(
+    tx: optax.GradientTransformation,
+    mesh: DeviceMesh,
+    param_pspecs,
+    dp_dims: Sequence[str] = ("dp",),
+) -> optax.GradientTransformation:
+    """Wrap an optax transform so its state is ZeRO-sharded over ``dp_dims``.
+
+    ``param_pspecs``: pytree of PartitionSpec matching the params tree (from
+    DModule.variables_shardings / pspec_of)."""
+
+    def init(params):
+        return _constrain_state(tx.init(params), params, param_pspecs, mesh, dp_dims)
+
+    def update(grads, state, params=None, **kw):
+        updates, new_state = tx.update(grads, state, params, **kw)
+        return updates, _constrain_state(new_state, params, param_pspecs, mesh, dp_dims)
+
+    return optax.GradientTransformation(init, update)
+
+
+def clip_grad_norm_fp32(grads, max_norm: float, norm_type: int = 2):
+    """Global-norm clip in fp32 (reference clip_grads.py:21).  The norm
+    reduction over sharded grads compiles to the cross-mesh all-reduce the
+    reference issues explicitly.  Returns (clipped_grads, total_norm)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    # pre-scale by the global max |g| so the squared sum cannot overflow fp32
+    # (1e20-magnitude grads would otherwise clip to zero silently)
+    gmax = jnp.maximum(
+        jnp.asarray(1e-30, jnp.float32),
+        jnp.max(jnp.stack([jnp.max(jnp.abs(g.astype(jnp.float32))) for g in leaves])),
+    )
+    if norm_type == 2:
+        total = gmax * jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32) / gmax)) for g in leaves))
+    else:
+        total = gmax * sum(jnp.sum(jnp.abs(g.astype(jnp.float32) / gmax) ** norm_type) for g in leaves) ** (
+            1.0 / norm_type
+        )
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads), total
+
+
+# ---------------------------------------------------------------- wrappers
+class BasicOptimizer:
+    """DP-replicated optimizer wrapper (reference base_optimizer.py:116):
+    plain optax step + grad-sync contract (automatic under jit)."""
+
+    def __init__(self, optimizer: optax.GradientTransformation, models=None, grad_clip: Optional[float] = None):
+        self.tx = optimizer
+        self.grad_clip = grad_clip
+
+    def init(self, params):
+        return self.tx.init(params)
+
+    def step(self, params, opt_state, grads):
+        if self.grad_clip is not None:
+            grads, _ = clip_grad_norm_fp32(grads, self.grad_clip)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+
+class DistributedOptimizer:
+    """ZeRO-2+ optimizer (reference distributed_optimizer.py:131).
+
+    fp32 master params + optimizer states sharded over the DP mesh dims;
+    params may be any dtype (bf16 training).  ``step`` is jit-friendly:
+
+        dopt = DistributedOptimizer(optax.adamw(...), mesh, param_pspecs)
+        state = dopt.init(params)
+        params, state = jax.jit(dopt.step)(params, state, grads)
+
+    Grad reduce-scatter / param all-gather / overlap are emitted by XLA from
+    the sharding constraints (see module docstring).
+    """
+
+    def __init__(
+        self,
+        optimizer: optax.GradientTransformation,
+        mesh: DeviceMesh = None,
+        param_pspecs=None,
+        models=None,
+        dp_dims: Sequence[str] = ("dp",),
+        grad_clip: Optional[float] = None,
+        main_param_dtype=jnp.float32,
+        overlap_param_gather: bool = True,  # parity flag; XLA handles overlap
+        **_: Any,
+    ):
+        self.mesh = mesh
+        self.dp_dims = tuple(dp_dims)
+        self.param_pspecs = param_pspecs
+        self.grad_clip = grad_clip
+        self.main_param_dtype = main_param_dtype
+        self.tx = (
+            zero_sharded(optimizer, mesh, param_pspecs, dp_dims)
+            if mesh is not None and param_pspecs is not None
+            else optimizer
+        )
+
+    # ------------------------------------------------------------- state
+    def init(self, params):
+        main = jax.tree_util.tree_map(lambda p: p.astype(self.main_param_dtype), params)
+        if self.mesh is not None and self.param_pspecs is not None:
+            main = _constrain_state(main, params, self.param_pspecs, self.mesh, self.dp_dims)
+        return {"inner": self.tx.init(main), "main_params": main}
+
+    # -------------------------------------------------------------- step
+    def step(self, params, opt_state, grads):
+        """copy grads -> fp32, clip, inner step on fp32 master shards,
+        copy master -> model params (reference step/:1142-1223 pipeline)."""
+        grads32 = jax.tree_util.tree_map(lambda g: g.astype(self.main_param_dtype), grads)
+        if self.grad_clip is not None:
+            grads32, _ = clip_grad_norm_fp32(grads32, self.grad_clip)
+        main = opt_state["main_params"]
+        updates, inner = self.tx.update(grads32, opt_state["inner"], main)
+        main = optax.apply_updates(main, updates)
+        new_params = jax.tree_util.tree_map(lambda m, p: m.astype(p.dtype), main, params)
+        return new_params, {"inner": inner, "main_params": main}
+
+    def state_pspecs(self, params):
+        """PartitionSpecs of the optimizer state (metadata only — used by
+        checkpoint planners; no state is materialized)."""
+        state = jax.eval_shape(self.init, params)
+        param_paths, pspec_by_path = _param_path_maps(params, self.param_pspecs)
+
+        def one(kp, leaf):
+            if not hasattr(leaf, "shape") or len(leaf.shape) == 0:
+                return PartitionSpec()
+            ps = _state_pspec(kp, tuple(leaf.shape), param_paths, pspec_by_path, self.mesh, self.dp_dims)
+            return ps if ps is not None else PartitionSpec()
+
+        return jax.tree_util.tree_map_with_path(one, state)
+
+
+# -------------------------------------------------------------------- muon
+def _newton_schulz(G, steps: int = 5, eps: float = 1e-7):
+    """Quintic Newton-Schulz orthogonalization (Muon).  Runs in bf16 on the
+    MXU; operates on the full 2-D gradient."""
+    a, b, c = 3.4445, -4.7750, 2.0315
+    X = G.astype(jnp.bfloat16)
+    X = X / (jnp.linalg.norm(X.astype(jnp.float32)) + eps)
+    transpose = G.shape[0] > G.shape[1]
+    if transpose:
+        X = X.T
+
+    def body(X, _):
+        A = X @ X.T
+        B = b * A + c * (A @ A)
+        return a * X + B @ X, None
+
+    X, _ = jax.lax.scan(body, X, None, length=steps)
+    if transpose:
+        X = X.T
+    return X.astype(G.dtype)
+
+
+def muon(
+    learning_rate: float = 0.02,
+    momentum: float = 0.95,
+    nesterov: bool = True,
+    ns_steps: int = 5,
+    fallback: Optional[optax.GradientTransformation] = None,
+) -> optax.GradientTransformation:
+    """Muon optimizer: momentum + Newton-Schulz orthogonalized updates for
+    2-D params; ``fallback`` (default adamw 3e-4) for others.  The
+    reference's gather-compute-scatter over RaggedShard params
+    (raggedshard.md) is GSPMD-implicit: the NS matmuls force an all-gather
+    of the 2-D param's gradient, and the result re-shards on write."""
+    fallback = fallback or optax.adamw(3e-4)
+
+    def mom_init(params):
+        return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def mom_update(grads, mom, params=None, **_kw):
+        new_mom = jax.tree_util.tree_map(lambda m, g: momentum * m + g, mom, grads)
+
+        def one(g, m):
+            eff = momentum * m + g if nesterov else m
+            o = _newton_schulz(eff, ns_steps)
+            scale = jnp.sqrt(jnp.maximum(1.0, g.shape[0] / g.shape[1]))
+            return (-learning_rate * scale * o).astype(g.dtype)
+
+        return jax.tree_util.tree_map(one, grads, new_mom), new_mom
+
+    muon_core = optax.GradientTransformation(mom_init, mom_update)
+
+    _EXCLUDE = ("embed", "embedding", "wte", "wpe", "lm_head", "head")
+
+    def labels(params):
+        # the Muon recipe orthogonalizes hidden 2-D weights only; embeddings
+        # and output heads go to the fallback optimizer
+        def one(kp, p):
+            path = ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp).lower()
+            if p.ndim != 2 or any(tok in path for tok in _EXCLUDE):
+                return "fallback"
+            return "muon"
+
+        return jax.tree_util.tree_map_with_path(one, params)
+
+    return optax.multi_transform({"muon": muon_core, "fallback": fallback}, labels)
