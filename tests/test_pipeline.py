@@ -1,0 +1,212 @@
+"""Pipeline parallel tests (mirrors reference legacy/test/parallel/pipeline/:
+api tests, instruction tests, and the e2e accuracy-alignment test
+test_pp_accuracy_alignment.py — PP must match single-device execution)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import vescale_tpu as vt
+from vescale_tpu.models.nanogpt import (
+    GPT,
+    GPTConfig,
+    cross_entropy_loss,
+    gpt_pipeline_units,
+)
+from vescale_tpu.pipe import (
+    Instruction,
+    InstructionKind,
+    PipeEngine,
+    construct_pipeline_stage,
+    build_schedule,
+    gpipe_schedule,
+    one_f_one_b_schedule,
+    interleaved_1f1b_schedule,
+    zero_bubble_schedule,
+)
+from vescale_tpu.plan import (
+    PipelineParallelPlan,
+    PipelineScheduleType,
+    PipelineSplitMethodType,
+)
+
+CFG = GPTConfig(block_size=16, vocab_size=64, n_layer=4, n_head=2, n_embd=32, dropout=0.0)
+
+
+def _schedule_well_formed(sched, S, M, zb=False):
+    for s, ins_list in enumerate(sched):
+        fwd = [i for i in ins_list if i.kind == InstructionKind.FORWARD]
+        assert len(fwd) == M or len(fwd) == M * max(
+            1, len({i.chunk for i in ins_list})
+        ), f"stage {s} fwd count"
+        if zb:
+            dg = [i for i in ins_list if i.kind == InstructionKind.BACKWARD_DGRAD]
+            wg = [i for i in ins_list if i.kind == InstructionKind.BACKWARD_WGRAD]
+            assert len(dg) == M and len(wg) == M
+            # every W comes after its Bd
+            for m in range(M):
+                assert ins_list.index(
+                    Instruction(InstructionKind.BACKWARD_DGRAD, s, m)
+                ) < ins_list.index(Instruction(InstructionKind.BACKWARD_WGRAD, s, m))
+        else:
+            bwd = [i for i in ins_list if i.kind == InstructionKind.BACKWARD]
+            assert len(bwd) == len(fwd)
+
+
+def test_schedule_generators():
+    _schedule_well_formed(gpipe_schedule(4, 8), 4, 8)
+    _schedule_well_formed(one_f_one_b_schedule(4, 8), 4, 8)
+    _schedule_well_formed(zero_bubble_schedule(4, 8), 4, 8, zb=True)
+    sched = interleaved_1f1b_schedule(2, 4, 2)
+    for s, ins in enumerate(sched):
+        fs = [i for i in ins if i.kind == InstructionKind.FORWARD]
+        assert len(fs) == 8  # M * V
+
+
+def test_construct_stage_splits():
+    units = gpt_pipeline_units(CFG)  # wte, wpe, h_0..h_3, ln_f, head = 8 units
+    plan = PipelineParallelPlan(num_stages=2, split_method=PipelineSplitMethodType.UNIFORM)
+    pm = construct_pipeline_stage(units, plan)
+    assert pm.num_groups == 2 and len(pm.groups[0]) == 4
+    plan_m = PipelineParallelPlan(
+        num_stages=2,
+        split_method=PipelineSplitMethodType.MANUAL,
+        split_points=["h_1"],
+    )
+    pm2 = construct_pipeline_stage(units, plan_m)
+    assert [u.name for u in pm2.groups[0]] == ["wte", "wpe", "h_0", "h_1"]
+    # shared embeddings group spans first and last group
+    assert pm2.shared_groups["embeddings"] == [(0, "wte"), (1, "head")]
+    plan_p = PipelineParallelPlan(num_stages=2, split_method=PipelineSplitMethodType.PARAMETERS)
+    pm3 = construct_pipeline_stage(units, plan_p, x_example=jnp.ones((1, 8), jnp.int32))
+    assert pm3.num_groups == 2
+
+
+def _golden(pm, params, batch, M):
+    """Sequential (no pipeline) run of the same groups."""
+
+    def loss_fn(p_all):
+        micros = jnp.split(batch["input"], M, axis=0)
+        tgts = jnp.split(batch["target"], M, axis=0)
+        total = 0.0
+        for xm, tm in zip(micros, tgts):
+            x = xm
+            for g in range(pm.num_groups):
+                x = pm.group_forward(g)(p_all[g], x)
+            total = total + cross_entropy_loss(x, tm)
+        return total / M
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    grads = pm.sync_shared_params_grads(list(grads))
+    return loss, grads
+
+
+@pytest.mark.parametrize(
+    "schedule",
+    [
+        PipelineScheduleType.GPIPE,
+        PipelineScheduleType.SIMPLE_1F1B,
+        PipelineScheduleType.ZERO_BUBBLE,
+    ],
+)
+def test_pp_accuracy_alignment(schedule):
+    """PP == single-device execution (reference
+    test_pp_accuracy_alignment.py)."""
+    units = gpt_pipeline_units(CFG)
+    plan = PipelineParallelPlan(num_stages=4, schedule_type=schedule)
+    pm = construct_pipeline_stage(units, plan)
+    params = pm.init_all(jax.random.key(0), jnp.ones((2, CFG.block_size), jnp.int32))
+    engine = PipeEngine(pm, plan, cross_entropy_loss)
+
+    toks = jax.random.randint(jax.random.key(1), (8, CFG.block_size + 1), 0, CFG.vocab_size)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+    M = 4
+    loss, grads = engine.forward_backward(params, batch, num_microbatches=M)
+    gloss, ggrads = _golden(pm, params, batch, M)
+    np.testing.assert_allclose(float(loss), float(gloss), rtol=1e-6)
+    for g in range(pm.num_groups):
+        ga = jax.tree_util.tree_leaves(grads[g])
+        gb = jax.tree_util.tree_leaves(ggrads[g])
+        for a, b in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_pp_interleaved_virtual_chunks():
+    units = gpt_pipeline_units(CFG)  # 8 units
+    plan = PipelineParallelPlan(
+        num_stages=2,
+        virtual_chunks=2,
+        schedule_type=PipelineScheduleType.INTERLEAVED_1F1B,
+    )
+    pm = construct_pipeline_stage(units, plan)
+    assert pm.num_groups == 4 and pm.virtual_chunks == 2
+    params = pm.init_all(jax.random.key(0), jnp.ones((2, CFG.block_size), jnp.int32))
+    engine = PipeEngine(pm, plan, cross_entropy_loss)
+    toks = jax.random.randint(jax.random.key(1), (8, CFG.block_size + 1), 0, CFG.vocab_size)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+    loss, grads = engine.forward_backward(params, batch, num_microbatches=4)
+    gloss, ggrads = _golden(pm, params, batch, 4)
+    np.testing.assert_allclose(float(loss), float(gloss), rtol=1e-6)
+    for g in range(pm.num_groups):
+        for a, b in zip(jax.tree_util.tree_leaves(grads[g]), jax.tree_util.tree_leaves(ggrads[g])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_tied_embedding_grads_synced():
+    units = gpt_pipeline_units(CFG)
+    plan = PipelineParallelPlan(num_stages=2)
+    pm = construct_pipeline_stage(units, plan)
+    params = pm.init_all(jax.random.key(0), jnp.ones((2, CFG.block_size), jnp.int32))
+    # tied params identical at init
+    np.testing.assert_array_equal(
+        np.asarray(params[0]["wte"]["wte"]["embedding"]),
+        np.asarray(params[1]["head"]["wte"]["embedding"]),
+    )
+    engine = PipeEngine(pm, plan, cross_entropy_loss)
+    toks = jax.random.randint(jax.random.key(1), (4, CFG.block_size + 1), 0, CFG.vocab_size)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+    _, grads = engine.forward_backward(params, batch, num_microbatches=2)
+    np.testing.assert_array_equal(
+        np.asarray(grads[0]["wte"]["wte"]["embedding"]),
+        np.asarray(grads[1]["head"]["wte"]["embedding"]),
+    )
+
+
+def test_spmd_pipeline_blocks(mesh1d):
+    """Compiled ppermute pipeline == sequential stage application, fwd+bwd."""
+    from vescale_tpu.pipe.spmd import pipeline_blocks, stack_stage_params
+    from vescale_tpu.models.nanogpt import Block
+
+    mesh = vt.DeviceMesh(("pp",), (4,))
+    blk = Block(CFG)
+    x = jax.random.normal(jax.random.key(0), (8, CFG.block_size, CFG.n_embd))
+    params_list = [
+        blk.init(jax.random.key(i), x[:2])["params"] for i in range(4)
+    ]
+    stacked = stack_stage_params(params_list)
+
+    def block_fn(p, xm):
+        return blk.apply({"params": p}, xm)
+
+    out = pipeline_blocks(block_fn, stacked, x, mesh, num_microbatches=4)
+    golden = x
+    for p in params_list:
+        golden = blk.apply({"params": p}, golden)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)
+
+    # differentiate through the pipeline
+    def loss_pp(stacked, x):
+        return jnp.sum(pipeline_blocks(block_fn, stacked, x, mesh, num_microbatches=4) ** 2)
+
+    def loss_seq(params_list, x):
+        y = x
+        for p in params_list:
+            y = blk.apply({"params": p}, y)
+        return jnp.sum(y**2)
+
+    g_pp = jax.grad(loss_pp)(stacked, x)
+    g_seq = jax.grad(loss_seq)(params_list, x)
+    g_seq_stacked = stack_stage_params(list(g_seq))
+    for a, b in zip(jax.tree_util.tree_leaves(g_pp), jax.tree_util.tree_leaves(g_seq_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)

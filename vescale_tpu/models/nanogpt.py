@@ -152,3 +152,65 @@ def nanogpt_plan(mesh, sequence_parallel: bool = True):
         r"ln_f": {"input": [seq_par], "output": [dp_only]},
     }
     return {"parameter": param_plan, "forward": fwd_plan}
+
+
+# ---------------------------------------------------------------- pipeline
+class TokEmbed(nn.Module):
+    """Token embedding unit (pipeline stage granularity)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, idx):
+        c = self.config
+        return nn.Embed(c.vocab_size, c.n_embd, dtype=c.dtype, name="wte")(idx)
+
+
+class PosEmbed(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        T = x.shape[1]
+        pos = jnp.arange(T)[None, :]
+        return x + nn.Embed(c.block_size, c.n_embd, dtype=c.dtype, name="wpe")(pos)
+
+
+class FinalNorm(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.LayerNorm(use_bias=self.config.bias, dtype=self.config.dtype, name="ln_f")(x)
+
+
+class TiedHead(nn.Module):
+    """LM head tied to the token embedding: identical param structure to
+    TokEmbed so a pipeline shared-group can alias them (reference
+    build_shared_module_group, pipe_stage.py:311)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        return nn.Embed(c.vocab_size, c.n_embd, dtype=c.dtype, name="wte").attend(x)
+
+
+def gpt_pipeline_units(config: GPTConfig):
+    """Ordered stage units for PP: [wte*, wpe, h_0..h_{L-1}, ln_f, head*]
+    (* = tied 'embeddings' shared group).  Feed to
+    vescale_tpu.pipe.construct_pipeline_stage."""
+    from ..pipe.pipe_stage import StageUnit
+
+    units = [
+        StageUnit("wte", TokEmbed(config), shared_group="embeddings"),
+        StageUnit("wpe", PosEmbed(config)),
+    ]
+    units += [StageUnit(f"h_{i}", Block(config, name=f"h_{i}")) for i in range(config.n_layer)]
+    units += [
+        StageUnit("ln_f", FinalNorm(config)),
+        StageUnit("head", TiedHead(config), shared_group="embeddings"),
+    ]
+    return units

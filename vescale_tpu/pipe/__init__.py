@@ -1,0 +1,11 @@
+from .pipe_stage import PipeModule, construct_pipeline_stage
+from .schedules import (
+    Instruction,
+    InstructionKind,
+    gpipe_schedule,
+    one_f_one_b_schedule,
+    interleaved_1f1b_schedule,
+    zero_bubble_schedule,
+    build_schedule,
+)
+from .engine import PipeEngine

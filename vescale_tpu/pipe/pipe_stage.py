@@ -1,0 +1,203 @@
+"""Pipeline stage construction.
+
+Capability parity with the reference PipeParser/PipeModule
+(legacy/vescale/pipe/pipe_parser.py:46, pipe_stage.py:64,285,311):
+  - split a model into stages (uniform / manual split points / by-params)
+  - virtual chunks for interleaved schedules (looping_bfs.py)
+  - shared-module groups (tied embeddings) synced across stages
+  - per-stage param partitions
+
+TPU-native: there is no fx graph to trace — a JAX model is already a
+function.  Stage splitting is *module-path splitting* over a sequence of
+stage units (SURVEY §7.6: "the GRAPH_EAGER fx-tracing mode translates to
+simple module-path splitting since JAX has no fx").  A stage unit is any
+flax module; the canonical decomposition for decoder LMs is
+[embed, block_0..block_{L-1}, head].
+
+With ``virtual_chunks`` V > 1 the units are split into S*V *groups*; group
+``g`` runs as model-chunk ``g // S`` on physical stage ``g % S`` (Megatron
+VPP assignment).  A microbatch traverses groups in order g = 0..S*V-1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..plan import PipelineParallelPlan, PipelineSplitMethodType
+
+__all__ = ["PipeModule", "construct_pipeline_stage", "StageUnit"]
+
+
+@dataclasses.dataclass
+class StageUnit:
+    """One indivisible unit (reference smallest_unsplittable_units)."""
+
+    name: str
+    module: nn.Module
+    shared_group: Optional[str] = None  # e.g. "embeddings" for tied wte
+
+
+class PipeModule:
+    """Holds per-group unit lists + param partitions + shared groups
+    (reference pipe_stage.py:64)."""
+
+    def __init__(self, groups: List[List[StageUnit]], plan: PipelineParallelPlan):
+        self.groups = groups
+        self.plan = plan
+        self.num_stages = plan.num_stages
+        self.virtual_chunks = max(1, len(groups) // plan.num_stages)
+        if len(groups) != self.num_stages * self.virtual_chunks:
+            raise ValueError(
+                f"{len(groups)} groups != num_stages {self.num_stages} x virtual chunks"
+            )
+        # shared groups: name -> [(group_idx, unit_name), ...]
+        self.shared_groups: Dict[str, List[Tuple[int, str]]] = {}
+        for g, units in enumerate(groups):
+            for u in units:
+                if u.shared_group:
+                    self.shared_groups.setdefault(u.shared_group, []).append((g, u.name))
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    def group_index(self, stage: int, chunk: int = 0) -> int:
+        return chunk * self.num_stages + stage
+
+    def stage_of_group(self, g: int) -> Tuple[int, int]:
+        """(physical stage, chunk) of group g."""
+        return g % self.num_stages, g // self.num_stages
+
+    # ------------------------------------------------------------- init
+    def init_all(self, rng, x_example):
+        """Init every group in model order, propagating activation shapes and
+        sharing tied params (reference deferred pipeline init +
+        build_shared_module_group, pipe_stage.py:311).  Returns per-group
+        params list."""
+        shared: Dict[str, Any] = {}
+        all_params = []
+        x = x_example
+        for g in range(self.num_groups):
+            params = {}
+            for u in self.groups[g]:
+                if u.shared_group and u.shared_group in shared:
+                    p = shared[u.shared_group]
+                else:
+                    rng, sub = jax.random.split(rng)
+                    p = u.module.init(sub, x)["params"]
+                    if u.shared_group:
+                        shared[u.shared_group] = p
+                params[u.name] = p
+                out = jax.eval_shape(lambda pp, xx: u.module.apply({"params": pp}, xx), p, x)
+                x = jnp.zeros(out.shape, out.dtype)
+            all_params.append(params)
+        return all_params
+
+    # ---------------------------------------------------------- forward
+    def group_forward(self, g: int) -> Callable:
+        """Pure fn (group_params, x) -> y running group g's units."""
+        units = self.groups[g]
+
+        def fwd(params, x):
+            for u in units:
+                x = u.module.apply({"params": params[u.name]}, x)
+            return x
+
+        return fwd
+
+    def stage_forward(self, stage: int, chunk: int = 0) -> Callable:
+        return self.group_forward(self.group_index(stage, chunk))
+
+    def sync_shared_params_grads(self, grads_per_group):
+        """Sum grads of tied params across their groups (reference
+        engine/pipe.py:211 sync_shared_params)."""
+        for name, members in self.shared_groups.items():
+            if len(members) < 2:
+                continue
+            total = None
+            for g, uname in members:
+                gr = grads_per_group[g].get(uname)
+                if gr is None:
+                    continue
+                total = gr if total is None else jax.tree_util.tree_map(jnp.add, total, gr)
+            for g, uname in members:
+                if uname in grads_per_group[g]:
+                    grads_per_group[g][uname] = total
+        return grads_per_group
+
+
+def _cuts_by_weight(weights: List[float], n: int) -> List[int]:
+    """Contiguous partition of unit weights into n groups balancing totals
+    (same greedy as the reference's params/uniform split)."""
+    total = sum(weights)
+    target = total / n
+    cuts = []
+    acc = 0.0
+    for k, w in enumerate(weights):
+        if len(cuts) < n - 1 and acc >= target * (len(cuts) + 1):
+            cuts.append(k)
+        acc += w
+    while len(cuts) < n - 1:
+        cuts.append(len(weights) - (n - 1 - len(cuts)))
+    return cuts
+
+
+def construct_pipeline_stage(
+    units: Sequence[StageUnit],
+    plan: PipelineParallelPlan,
+    x_example=None,
+) -> PipeModule:
+    """Split an ordered list of stage units into ``num_stages * virtual_chunks``
+    groups (reference construct_pipeline_stage, pipe_stage.py:285).
+
+    - MANUAL: ``plan.split_points`` lists the unit *names that end* each group
+      but the last (num_stages*virtual_chunks - 1 names).
+    - UNIFORM: balance by unit count.
+    - PARAMETERS: balance by param count (needs x_example).
+    """
+    units = list(units)
+    n = plan.num_stages * max(1, plan.virtual_chunks)
+    if n > len(units):
+        raise ValueError(f"{n} groups for {len(units)} units")
+
+    if plan.split_method == PipelineSplitMethodType.MANUAL:
+        if not plan.split_points or len(plan.split_points) != n - 1:
+            raise ValueError(f"MANUAL split needs {n - 1} split_points")
+        names = [u.name for u in units]
+        cuts = []
+        for sp in plan.split_points:
+            if sp not in names:
+                raise ValueError(f"split point {sp!r} not among units {names}")
+            cuts.append(names.index(sp) + 1)
+        if cuts != sorted(cuts):
+            raise ValueError("split_points must be in model order")
+    elif plan.split_method == PipelineSplitMethodType.PARAMETERS:
+        if x_example is None:
+            raise ValueError("PARAMETERS split needs x_example")
+        weights = []
+        x = x_example
+        rng = jax.random.key(0)
+        for u in units:
+            vars_ = jax.eval_shape(lambda r, xx: u.module.init(r, xx), rng, x)
+            w = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(vars_))
+            weights.append(float(w))
+            out = jax.eval_shape(
+                lambda v, xx: u.module.apply({"params": v["params"]}, xx), vars_, x
+            )
+            x = jnp.zeros(out.shape, out.dtype)
+        cuts = _cuts_by_weight(weights, n)
+    else:  # UNIFORM
+        per = len(units) / n
+        cuts = [int(round(per * (i + 1))) for i in range(n - 1)]
+
+    bounds = [0] + list(cuts) + [len(units)]
+    groups = [units[bounds[i]:bounds[i + 1]] for i in range(n)]
+    if any(len(g) == 0 for g in groups):
+        raise ValueError(f"empty pipeline group in split {bounds}")
+    return PipeModule(groups, plan)

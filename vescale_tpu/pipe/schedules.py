@@ -1,0 +1,183 @@
+"""Pipeline schedules — instruction IR + generators.
+
+Capability parity with the reference instruction VM
+(legacy/vescale/pipe/_schedules/):
+  - instruction base/registry      <- instruction_base.py:58
+  - 1F1B (pipedream flush)         <- pipedream_flush.py:653,762
+  - GPipe                          <- pipedream_flush.py (forward_backward_no_pipelining variants)
+  - Interleaved 1F1B (VPP)         <- looping_bfs.py:699,873
+  - Zero-bubble (split B into dgrad/wgrad)  <- zero_bubble_v.py:132,198,602
+
+The IR is deliberately tiny: SEND/RECV pairs are implicit in the
+single-controller engine (activations flow through a table; on hardware the
+transfer is an XLA transfer/ppermute — see spmd.py for the compiled path),
+so instructions carry only compute semantics + ordering.  Zero-bubble's
+W/B split is first-class: B (dgrad) propagates the activation gradient,
+W (wgrad) accumulates the weight gradient later, filling bubbles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List
+
+from ..plan import PipelineParallelPlan, PipelineScheduleType
+
+__all__ = [
+    "InstructionKind",
+    "Instruction",
+    "gpipe_schedule",
+    "one_f_one_b_schedule",
+    "interleaved_1f1b_schedule",
+    "zero_bubble_schedule",
+    "build_schedule",
+]
+
+
+class InstructionKind(enum.Enum):
+    FORWARD = "F"
+    BACKWARD = "B"        # full backward (dgrad + wgrad fused)
+    BACKWARD_DGRAD = "Bd"  # zero-bubble: input grad only
+    BACKWARD_WGRAD = "W"   # zero-bubble: weight grad accumulation
+
+
+@dataclasses.dataclass(frozen=True)
+class Instruction:
+    kind: InstructionKind
+    stage: int          # physical pipeline stage
+    microbatch: int
+    chunk: int = 0      # virtual/model chunk (interleaved schedules)
+
+    def __repr__(self):
+        c = f"c{self.chunk}" if self.chunk else ""
+        return f"{self.kind.value}{c}(s{self.stage},m{self.microbatch})"
+
+
+def gpipe_schedule(num_stages: int, num_microbatches: int) -> List[List[Instruction]]:
+    """All forwards, then all backwards (reference GPIPE mode)."""
+    out = []
+    for s in range(num_stages):
+        ins = [Instruction(InstructionKind.FORWARD, s, m) for m in range(num_microbatches)]
+        ins += [
+            Instruction(InstructionKind.BACKWARD, s, m)
+            for m in reversed(range(num_microbatches))
+        ]
+        out.append(ins)
+    return out
+
+
+def one_f_one_b_schedule(num_stages: int, num_microbatches: int) -> List[List[Instruction]]:
+    """PipeDream-flush 1F1B (reference pipedream_flush.py:762): per stage,
+    ``num_stages - s - 1`` warmup forwards, then 1F1B steady state, then
+    cooldown backwards."""
+    F, B = InstructionKind.FORWARD, InstructionKind.BACKWARD
+    out = []
+    for s in range(num_stages):
+        warmup = min(num_stages - s - 1, num_microbatches)
+        remaining = num_microbatches - warmup
+        ins = [Instruction(F, s, m) for m in range(warmup)]
+        fwd_m, bwd_m = warmup, 0
+        for _ in range(remaining):
+            ins.append(Instruction(F, s, fwd_m))
+            fwd_m += 1
+            ins.append(Instruction(B, s, bwd_m))
+            bwd_m += 1
+        while bwd_m < num_microbatches:
+            ins.append(Instruction(B, s, bwd_m))
+            bwd_m += 1
+        out.append(ins)
+    return out
+
+
+def interleaved_1f1b_schedule(
+    num_stages: int, num_microbatches: int, virtual_chunks: int
+) -> List[List[Instruction]]:
+    """Interleaved 1F1B / VPP (reference looping_bfs.py:873).  Each physical
+    stage hosts ``virtual_chunks`` model chunks; microbatches cycle chunks in
+    groups of ``num_stages`` (Megatron ordering).
+
+    The generated order is dependency-consistent for the eager engine; exact
+    bubble timing is the compiled path's concern."""
+    F, B = InstructionKind.FORWARD, InstructionKind.BACKWARD
+    M, S, V = num_microbatches, num_stages, virtual_chunks
+    out = []
+    total = M * V
+    for s in range(S):
+        # forward order: chunks in waves of min(S, M) microbatches
+        fwd_order = []
+        group = min(S, M)
+        m0 = 0
+        while len(fwd_order) < total:
+            for v in range(V):
+                for m in range(m0, min(m0 + group, M)):
+                    fwd_order.append((m, v))
+            m0 += group
+        bwd_order = []
+        m0 = 0
+        while len(bwd_order) < total:
+            for v in reversed(range(V)):
+                for m in range(m0, min(m0 + group, M)):
+                    bwd_order.append((m, v))
+            m0 += group
+        warmup = min((S - s - 1) * 2 + (V - 1) * S, total)
+        ins = [Instruction(F, s, m, v) for m, v in fwd_order[:warmup]]
+        fi, bi = warmup, 0
+        while fi < total or bi < total:
+            if fi < total:
+                m, v = fwd_order[fi]
+                ins.append(Instruction(F, s, m, v))
+                fi += 1
+            if bi < total:
+                m, v = bwd_order[bi]
+                ins.append(Instruction(B, s, m, v))
+                bi += 1
+        out.append(ins)
+    return out
+
+
+def zero_bubble_schedule(num_stages: int, num_microbatches: int) -> List[List[Instruction]]:
+    """Zero-bubble (ZB-H1-style, reference zero_bubble_v.py): 1F1B skeleton
+    with backward split into Bd (dgrad, on the critical path) and W (wgrad,
+    deferred to fill bubbles / drained at the end)."""
+    F, Bd, W = InstructionKind.FORWARD, InstructionKind.BACKWARD_DGRAD, InstructionKind.BACKWARD_WGRAD
+    out = []
+    for s in range(num_stages):
+        warmup = min(num_stages - s - 1, num_microbatches)
+        remaining = num_microbatches - warmup
+        ins = [Instruction(F, s, m) for m in range(warmup)]
+        fwd_m, bwd_m, w_m = warmup, 0, 0
+        for _ in range(remaining):
+            ins.append(Instruction(F, s, fwd_m))
+            fwd_m += 1
+            ins.append(Instruction(Bd, s, bwd_m))
+            bwd_m += 1
+            # defer W by num_stages-s-1 microbatches to fill the bubble
+            if bwd_m - w_m > max(0, num_stages - s - 1):
+                ins.append(Instruction(W, s, w_m))
+                w_m += 1
+        while bwd_m < num_microbatches:
+            ins.append(Instruction(Bd, s, bwd_m))
+            bwd_m += 1
+            if w_m < bwd_m - 1:
+                ins.append(Instruction(W, s, w_m))
+                w_m += 1
+        while w_m < num_microbatches:
+            ins.append(Instruction(W, s, w_m))
+            w_m += 1
+        out.append(ins)
+    return out
+
+
+def build_schedule(plan: PipelineParallelPlan, num_microbatches: int) -> List[List[Instruction]]:
+    """Reference ScheduleEngine/PipelineEmitter dispatch (pipe_emmiter.py:132)."""
+    st = plan.schedule_type
+    if st == PipelineScheduleType.GPIPE:
+        return gpipe_schedule(plan.num_stages, num_microbatches)
+    if st == PipelineScheduleType.SIMPLE_1F1B:
+        return one_f_one_b_schedule(plan.num_stages, num_microbatches)
+    if st == PipelineScheduleType.INTERLEAVED_1F1B:
+        return interleaved_1f1b_schedule(plan.num_stages, num_microbatches, plan.virtual_chunks)
+    if st == PipelineScheduleType.ZERO_BUBBLE:
+        return zero_bubble_schedule(plan.num_stages, num_microbatches)
+    raise NotImplementedError(f"schedule {st}")

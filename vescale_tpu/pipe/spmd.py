@@ -1,0 +1,112 @@
+"""Compiled SPMD pipeline — the TPU perf path.
+
+Where the reference drives PP with per-rank executors + NCCL send/recv
+(legacy/vescale/pipe/p2p_communication.py), the TPU-native path compiles the
+WHOLE pipeline into one XLA program: stage params are stacked on a ``pp``
+mesh axis, microbatches stream through a ``lax.scan`` whose steady state
+rotates activations with ``lax.ppermute`` over ICI.  Reverse-mode AD
+transposes the ppermute (reverse rotation), so ``jax.grad`` of this function
+IS the backward pipeline — 1F1B emerges from XLA's scheduler rather than an
+instruction VM.  (Pattern from public JAX pipelining recipes; see the
+scaling-book's pipelining chapter.)
+
+Requirements: homogeneous stages (same block params structure per stage) —
+the canonical transformer middle.  Embedding/head run outside, replicated or
+dp/tp-sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..mesh import DeviceMesh
+from ..collectives import shard_map
+
+__all__ = ["pipeline_blocks", "stack_stage_params"]
+
+
+def stack_stage_params(params_list):
+    """Stack per-stage param trees (same structure) along a new leading axis
+    -> leaves (S, ...)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *params_list)
+
+
+def pipeline_blocks(
+    block_fn: Callable,
+    stacked_params,
+    x,
+    mesh: DeviceMesh,
+    pp_dim: str = "pp",
+    num_microbatches: Optional[int] = None,
+    extra_specs: Optional[P] = None,
+):
+    """Apply ``num_stages`` sequential stages (one per pp-mesh rank) to ``x``,
+    pipelined over microbatches.
+
+    ``block_fn(stage_params, x_micro) -> y_micro`` must preserve the
+    activation shape.  ``stacked_params`` leaves are (S, ...), sharded on
+    ``pp``.  ``x``: (B, ...) with B divisible by num_microbatches.
+    Returns (B, ...) outputs (as if stages were applied sequentially).
+    """
+    S = mesh.size(pp_dim)
+    M = num_microbatches or S
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    xm = x.reshape(M, B // M, *x.shape[1:])
+
+    act_spec = extra_specs if extra_specs is not None else P()
+
+    def worker(params, xm_local):
+        # params leaves: (1, ...) local slice -> squeeze stage axis
+        params = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, 0), params)
+        idx = jax.lax.axis_index(pp_dim)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        micro = xm_local  # (M, b, ...)
+        outs0 = jnp.zeros_like(micro)
+        act0 = jnp.zeros_like(micro[0])
+
+        def body(carry, t):
+            act, outs = carry
+            x_in = jnp.where(
+                idx == 0,
+                jax.lax.dynamic_index_in_dim(micro, jnp.minimum(t, M - 1), 0, keepdims=False),
+                act,
+            )
+            y = block_fn(params, x_in)
+            out_t = t - (S - 1)
+            collect = (idx == S - 1) & (out_t >= 0)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs,
+                jnp.where(
+                    collect,
+                    y,
+                    jax.lax.dynamic_index_in_dim(outs, jnp.maximum(out_t, 0), 0, keepdims=False),
+                ),
+                jnp.maximum(out_t, 0),
+                0,
+            )
+            act_next = jax.lax.ppermute(y, pp_dim, perm)
+            return (act_next, outs), None
+
+        (_, outs), _ = jax.lax.scan(body, (act0, outs0), jnp.arange(M + S - 1))
+        # only the last stage holds real outputs; psum broadcasts them
+        # (zeros elsewhere) so downstream (head/loss) sees the full tensor
+        outs = jnp.where(idx == S - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(outs, pp_dim)
+
+    out = shard_map(
+        worker,
+        mesh=mesh.jax_mesh,
+        in_specs=(P(pp_dim), act_spec),
+        out_specs=act_spec,
+        check_vma=False,
+        # only pp is manual — dp/tp/sp remain auto so GSPMD shards the
+        # per-stage compute (4D composition: PP x DP x TP x SP)
+        axis_names=frozenset({pp_dim}) if mesh.ndim > 1 else frozenset(mesh.mesh_dim_names),
+    )(stacked_params, xm)
+    return out.reshape(B, *x.shape[1:])

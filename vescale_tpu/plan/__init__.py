@@ -1,0 +1,8 @@
+from .spec import (
+    ModeType,
+    PipelineP2PSpec,
+    PipelineScheduleType,
+    PipelineSplitMethodType,
+    TracerType,
+)
+from .pipeline_parallel import PipelineParallelPlan

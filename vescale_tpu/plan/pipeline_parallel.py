@@ -1,0 +1,36 @@
+"""PipelineParallelPlan (reference legacy/vescale/plan/pipeline_parallel.py:28)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+from .spec import ModeType, PipelineScheduleType, PipelineSplitMethodType, TracerType
+
+__all__ = ["PipelineParallelPlan"]
+
+
+@dataclasses.dataclass
+class PipelineParallelPlan:
+    mode: ModeType = ModeType.EAGER
+    split_method: PipelineSplitMethodType = PipelineSplitMethodType.UNIFORM
+    num_stages: int = 2
+    virtual_chunks: int = 1
+    split_points: Optional[Sequence[str]] = None  # module names ending each stage
+    batch_p2p_comm: bool = True          # parity flags; XLA handles batching
+    overlap_p2p_comm: bool = True
+    use_zero_bubble: bool = False
+    schedule_type: PipelineScheduleType = PipelineScheduleType.SIMPLE_1F1B
+    num_model_chunks: int = 1
+    tracer_type: TracerType = TracerType.MODULE_PATH
+    smallest_unsplittable_units: Optional[Sequence[str]] = None
+    uniform_split_ops: bool = False
+    p2p_tensor_shapes: Optional[Any] = None
+    reuse_p2p_tensor_shape: bool = False
+    forward_only: bool = False
+
+    def __post_init__(self):
+        if self.schedule_type == PipelineScheduleType.INTERLEAVED_1F1B and self.virtual_chunks < 2:
+            self.virtual_chunks = max(2, self.num_model_chunks)
+        if self.use_zero_bubble:
+            self.schedule_type = PipelineScheduleType.ZERO_BUBBLE

@@ -1,0 +1,55 @@
+"""Plan enums (reference legacy/vescale/plan/spec.py:34-70)."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+__all__ = [
+    "ModeType",
+    "PipelineSplitMethodType",
+    "PipelineScheduleType",
+    "TracerType",
+    "PipelineP2PSpec",
+]
+
+
+class ModeType(enum.Enum):
+    EAGER = "eager"
+    GRAPH_EAGER = "graph_eager"
+    COMPILE = "compile"  # TPU-native: whole-pipeline shard_map/jit
+
+
+class PipelineSplitMethodType(enum.Enum):
+    UNIFORM = "uniform"
+    MANUAL = "manual"
+    PARAMETERS = "parameters"  # balance by param count
+    AUTOBALANCE = "autobalance"
+    FLOPS = "flops"
+
+
+class PipelineScheduleType(enum.Enum):
+    SIMPLE_1F1B = "1f1b"
+    INTERLEAVED_1F1B = "interleaved_1f1b"
+    GPIPE = "gpipe"
+    ZERO_BUBBLE = "zbv"
+    GRAPH_PIPE = "graph_pipe"
+
+
+class TracerType(enum.Enum):
+    """The reference's fx/HF/dynamo tracers (pipe/tracer.py:81,93) do not
+    exist on TPU — module-path splitting covers GRAPH_EAGER (SURVEY §7.6).
+    Kept for plan-compat."""
+
+    VESCALE_FX = "vescale_fx"
+    HF_FX = "hf_fx"
+    TORCH_DYNAMO = "dynamo"
+    MODULE_PATH = "module_path"  # the TPU-native mode
+
+
+@dataclasses.dataclass
+class PipelineP2PSpec:
+    """Reference plan/spec.py — p2p tensor spec for manual stage IO."""
+
+    peer_stage_idx: int
+    peer_output_idx: int = 0
