@@ -210,3 +210,29 @@ def test_spmd_pipeline_blocks(mesh1d):
     g_seq_stacked = stack_stage_params(list(g_seq))
     for a, b in zip(jax.tree_util.tree_leaves(g_pp), jax.tree_util.tree_leaves(g_seq_stacked)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_params_split_tail_heavy():
+    """regression: PARAMETERS split with weight concentrated in last units."""
+    from vescale_tpu.pipe.pipe_stage import _cuts_by_weight
+
+    cuts = _cuts_by_weight([1, 1, 1, 1, 1, 1, 60, 40], 4)
+    assert cuts == sorted(cuts) and len(set(cuts)) == 3
+    assert all(1 <= c <= 7 for c in cuts)
+
+
+def test_forward_only_without_target():
+    units = gpt_pipeline_units(CFG)
+    plan = PipelineParallelPlan(num_stages=2)
+    pm = construct_pipeline_stage(units, plan)
+    params = pm.init_all(jax.random.key(0), jnp.ones((2, CFG.block_size), jnp.int32))
+    engine = PipeEngine(pm, plan, cross_entropy_loss)
+    toks = jax.random.randint(jax.random.key(1), (4, CFG.block_size), 0, CFG.vocab_size)
+    loss, outs = engine.forward_backward(params, {"input": toks},
+                                         num_microbatches=2, forward_only=True)
+    assert loss is None and outs.shape == (4, CFG.block_size, CFG.vocab_size)
+    # golden
+    x = toks
+    for g in range(pm.num_groups):
+        x = pm.group_forward(g)(params[g], x)
+    np.testing.assert_allclose(np.asarray(outs), np.asarray(x), rtol=1e-5, atol=1e-5)

@@ -1,0 +1,216 @@
+"""LLaMA family — Llama-2 / Llama-3 / OpenLlama.
+
+Model rungs of the config ladder (BASELINE.md): the reference's examples
+train HF llama checkpoints (legacy/examples/llama2_4D_finetune/llama_train.py,
+open_llama_4D_benchmark/) with a 4D sharding plan
+(open_llama_4D_benchmark/sharding_plan.py).  This is an idiomatic flax
+re-implementation: RMSNorm, rotary embeddings, grouped-query attention,
+SwiGLU MLP, tied-or-untied head — bf16-first for the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..placements import Replicate, Shard
+
+__all__ = [
+    "LlamaConfig",
+    "Llama",
+    "llama_plan",
+    "LLAMA2_7B",
+    "LLAMA3_8B",
+    "LLAMA3_70B",
+    "LLAMA3_405B",
+    "OPEN_LLAMA_3B",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32   # < heads -> GQA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+LLAMA2_7B = LlamaConfig()
+OPEN_LLAMA_3B = LlamaConfig(hidden_size=3200, intermediate_size=8640, num_hidden_layers=26, num_attention_heads=32)
+LLAMA3_8B = LlamaConfig(
+    vocab_size=128256,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_hidden_layers=32,
+    num_attention_heads=32,
+    num_key_value_heads=8,
+    max_position_embeddings=8192,
+    rope_theta=500000.0,
+)
+LLAMA3_70B = LlamaConfig(
+    vocab_size=128256,
+    hidden_size=8192,
+    intermediate_size=28672,
+    num_hidden_layers=80,
+    num_attention_heads=64,
+    num_key_value_heads=8,
+    rope_theta=500000.0,
+)
+LLAMA3_405B = LlamaConfig(
+    vocab_size=128256,
+    hidden_size=16384,
+    intermediate_size=53248,
+    num_hidden_layers=126,
+    num_attention_heads=128,
+    num_key_value_heads=8,
+    rope_theta=500000.0,
+)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("weight", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        x32 = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.eps)
+        return (x32 * scale).astype(self.dtype)
+
+
+def rotary(q, k, positions, theta: float):
+    """Apply rotary position embeddings (fp32 phase math)."""
+    hd = q.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B,T,hd/2)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        c = self.config
+        B, T, E = x.shape
+        H, KV, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+        q = nn.Dense(H * hd, use_bias=False, dtype=c.dtype, name="q_proj")(x)
+        k = nn.Dense(KV * hd, use_bias=False, dtype=c.dtype, name="k_proj")(x)
+        v = nn.Dense(KV * hd, use_bias=False, dtype=c.dtype, name="v_proj")(x)
+        q = q.reshape(B, T, H, hd)
+        k = k.reshape(B, T, KV, hd)
+        v = v.reshape(B, T, KV, hd)
+        q, k = rotary(q, k, positions, c.rope_theta)
+        if KV != H:  # GQA: repeat kv heads
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(hd)
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        att = jnp.where(mask[None, None], att, jnp.finfo(jnp.float32).min)
+        att = jax.nn.softmax(att, axis=-1).astype(c.dtype)
+        y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, H * hd)
+        return nn.Dense(E, use_bias=False, dtype=c.dtype, name="o_proj")(y)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        g = nn.Dense(c.intermediate_size, use_bias=False, dtype=c.dtype, name="gate_proj")(x)
+        u = nn.Dense(c.intermediate_size, use_bias=False, dtype=c.dtype, name="up_proj")(x)
+        return nn.Dense(c.hidden_size, use_bias=False, dtype=c.dtype, name="down_proj")(
+            nn.silu(g) * u
+        )
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        c = self.config
+        x = x + LlamaAttention(c, name="self_attn")(
+            RMSNorm(c.rms_norm_eps, c.dtype, name="input_layernorm")(x), positions
+        )
+        x = x + LlamaMLP(c, name="mlp")(
+            RMSNorm(c.rms_norm_eps, c.dtype, name="post_attention_layernorm")(x)
+        )
+        return x
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, idx, deterministic: bool = True):
+        c = self.config
+        B, T = idx.shape
+        emb = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype, name="embed_tokens")
+        x = emb(idx)
+        positions = jnp.arange(T)[None, :].repeat(B, axis=0)
+        for i in range(c.num_hidden_layers):
+            x = LlamaBlock(c, name=f"layers_{i}")(x, positions)
+        x = RMSNorm(c.rms_norm_eps, c.dtype, name="norm")(x)
+        if c.tie_word_embeddings:
+            return emb.attend(x)
+        return nn.Dense(c.vocab_size, use_bias=False, dtype=c.dtype, name="lm_head")(x)
+
+
+def llama_plan(mesh, sequence_parallel: bool = True):
+    """4D TP/SP plan over mesh dims ("dp", "tp")
+    (reference legacy/examples/open_llama_4D_benchmark/sharding_plan.py):
+    column-parallel q/k/v + gate/up, row-parallel o/down, hidden-sharded
+    embedding, vocab-sharded head; RMSNorms replicated with SP activations."""
+    R, S = Replicate(), Shard
+    dp_only = [S(0), R]
+    seq_par = [S(0), S(1)] if sequence_parallel else dp_only
+    param_plan = {
+        r"embed_tokens\.embedding": [R, S(1)],
+        r"layers_\d+\.self_attn\.(q_proj|k_proj|v_proj)\.kernel": [R, S(1)],
+        r"layers_\d+\.self_attn\.o_proj\.kernel": [R, S(0)],
+        r"layers_\d+\.mlp\.(gate_proj|up_proj)\.kernel": [R, S(1)],
+        r"layers_\d+\.mlp\.down_proj\.kernel": [R, S(0)],
+        r"lm_head\.kernel": [R, S(1)],
+        r".*layernorm\.weight": [R, R],
+        r"norm\.weight": [R, R],
+        r".*": [R, R],
+    }
+    fwd_plan = {
+        r"": {"input": [dp_only], "output": [dp_only]},
+        r"layers_\d+\.(input_layernorm|post_attention_layernorm)": {
+            "input": [seq_par],
+            "output": [seq_par],
+        },
+        r"layers_\d+\.self_attn": {"input": [dp_only], "output": [dp_only]},
+        r"layers_\d+\.mlp": {"input": [dp_only], "output": [dp_only]},
+        r"norm": {"input": [seq_par], "output": [dp_only]},
+    }
+    return {"parameter": param_plan, "forward": fwd_plan}

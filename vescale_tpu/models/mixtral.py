@@ -1,0 +1,148 @@
+"""Mixtral — sparse-MoE LLaMA variant (Mixtral 8x7B rung of the ladder).
+
+Mirrors the reference's mixtral benchmark
+(legacy/examples/mixtral_4D_benchmark/mixtral_train.py + sharding_plan.py),
+re-built on the llama blocks with the vescale_tpu MoE layer: top-2 routed
+expert SwiGLU MLPs, expert-parallel over the "ep" mesh dim, TP inside
+experts optional via GSPMD.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..moe.layer import MoEConfig, MoEMLP
+from ..placements import Replicate, Shard
+from .llama import LlamaAttention, LlamaConfig, RMSNorm
+
+__all__ = ["MixtralConfig", "Mixtral", "mixtral_plan", "MIXTRAL_8X7B"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    num_local_experts: int = 8
+    num_experts_per_tok: int = 2
+    capacity_factor: float = 2.0
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 1000000.0
+    dtype: Any = jnp.bfloat16
+
+    def as_llama(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size,
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            num_key_value_heads=self.num_key_value_heads,
+            max_position_embeddings=self.max_position_embeddings,
+            rms_norm_eps=self.rms_norm_eps,
+            rope_theta=self.rope_theta,
+            dtype=self.dtype,
+        )
+
+    def moe(self) -> MoEConfig:
+        return MoEConfig(
+            num_experts=self.num_local_experts,
+            d_model=self.hidden_size,
+            d_ff=self.intermediate_size,
+            top_k=self.num_experts_per_tok,
+            capacity_factor=self.capacity_factor,
+            dtype=self.dtype,
+        )
+
+
+MIXTRAL_8X7B = MixtralConfig()
+
+
+class MixtralBlock(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        c = self.config
+        lc = c.as_llama()
+        x = x + LlamaAttention(lc, name="self_attn")(
+            RMSNorm(c.rms_norm_eps, c.dtype, name="input_layernorm")(x), positions
+        )
+        y, aux = MoEMLP(c.moe(), name="block_sparse_moe")(
+            RMSNorm(c.rms_norm_eps, c.dtype, name="post_attention_layernorm")(x)
+        )
+        self.sow("losses", "router_aux", aux)
+        return x + y
+
+
+class Mixtral(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, idx, deterministic: bool = True):
+        c = self.config
+        B, T = idx.shape
+        emb = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype, name="embed_tokens")
+        x = emb(idx)
+        positions = jnp.arange(T)[None, :].repeat(B, axis=0)
+        for i in range(c.num_hidden_layers):
+            x = MixtralBlock(c, name=f"layers_{i}")(x, positions)
+        x = RMSNorm(c.rms_norm_eps, c.dtype, name="norm")(x)
+        return nn.Dense(c.vocab_size, use_bias=False, dtype=c.dtype, name="lm_head")(x)
+
+
+def mixtral_plan(mesh, ep_dim: str = "ep", sequence_parallel: bool = False):
+    """TP + EP plan over mesh dims ("dp", "ep"/"tp", ...) (reference
+    mixtral_4D_benchmark/sharding_plan.py:23-70 + moe placement).  Attention
+    is TP-sharded over ``tp`` if present; experts Shard(0) over ``ep``."""
+    R, S = Replicate(), Shard
+    names = mesh.mesh_dim_names
+    has_tp = "tp" in names
+    ep = names.index(ep_dim) if ep_dim in names else None
+
+    def pl(**kw):
+        out = [R] * mesh.ndim
+        for dim_name, shard in kw.items():
+            if dim_name in names:
+                out[names.index(dim_name)] = shard
+        return out
+
+    dp_only = pl(dp=S(0))
+    param_plan = {
+        r".*block_sparse_moe\.(w_in|w_out|b_in|b_out)": pl(ep=S(0)),
+        r".*block_sparse_moe\.router": [R] * mesh.ndim,
+    }
+    if has_tp:
+        param_plan.update(
+            {
+                r"layers_\d+\.self_attn\.(q_proj|k_proj|v_proj)\.kernel": pl(tp=S(1)),
+                r"layers_\d+\.self_attn\.o_proj\.kernel": pl(tp=S(0)),
+                r"embed_tokens\.embedding": pl(tp=S(1)),
+                r"lm_head\.kernel": pl(tp=S(1)),
+            }
+        )
+    param_plan[r".*"] = [R] * mesh.ndim
+    fwd_plan = {r"": {"input": [dp_only], "output": [dp_only]}}
+    if sequence_parallel and has_tp:
+        seq_par = pl(dp=S(0), tp=S(1))
+        fwd_plan.update(
+            {
+                r"layers_\d+\.(input_layernorm|post_attention_layernorm)": {
+                    "input": [seq_par],
+                    "output": [seq_par],
+                },
+                r"layers_\d+\.self_attn": {"input": [dp_only], "output": [dp_only]},
+                r"layers_\d+\.block_sparse_moe": {"input": [dp_only], "output": [dp_only]},
+                r"norm": {"input": [seq_par], "output": [dp_only]},
+            }
+        )
+    return {"parameter": param_plan, "forward": fwd_plan}

@@ -1,0 +1,54 @@
+"""parallelize_experts — attach EP sharding to a model's MoE layers.
+
+Capability parity with the reference api (legacy/vescale/moe/api.py:30):
+``parallelize_experts(module, experts_expr, config)`` marks the expert
+params for expert-parallel placement.  TPU-native: returns a param-plan
+fragment (regex FQN -> placements) merging into the DModule plan — expert
+leaves (E, ...) get Shard(0) over the ep mesh dim, so the dispatch/combine
+einsums lower to all-to-alls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..dmodule.api import DModule, parallelize_module
+from ..mesh import DeviceMesh
+from ..placements import Replicate, Shard
+
+__all__ = ["moe_plan", "parallelize_experts"]
+
+
+def moe_plan(mesh: DeviceMesh, experts_expr: str = r".*moe.*", ep_dim: str = "ep") -> Dict[str, Any]:
+    """Param-plan fragment for MoE layers: expert-stacked leaves Shard(0)
+    over ``ep_dim``; the router stays replicated."""
+    ep = mesh._dim_index(ep_dim)
+
+    def pl(shard_dim: Optional[int]):
+        out = [Replicate()] * mesh.ndim
+        if shard_dim is not None:
+            out[ep] = Shard(shard_dim)
+        return out
+
+    return {
+        experts_expr.rstrip("$") + r"\.(w_in|w_out|b_in|b_out)": pl(0),
+        experts_expr.rstrip("$") + r"\.router": pl(None),
+    }
+
+
+def parallelize_experts(
+    module,
+    experts_expr: str = r".*moe.*",
+    device_mesh: Optional[DeviceMesh] = None,
+    sharding_plan: Optional[Dict[str, Any]] = None,
+    ep_dim: str = "ep",
+) -> DModule:
+    """Wrap a module so its MoE experts are EP-sharded (reference
+    moe/api.py:30).  Composes with an existing TP/SP plan."""
+    plan = dict(sharding_plan or {})
+    param_plan = dict(plan.get("parameter", {}))
+    # expert entries take precedence: put them first (regex dicts match in
+    # insertion order)
+    merged = {**moe_plan(device_mesh, experts_expr, ep_dim), **param_plan}
+    plan["parameter"] = merged
+    return parallelize_module(module, device_mesh, plan)

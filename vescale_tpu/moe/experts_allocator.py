@@ -1,0 +1,69 @@
+"""ExpertsAllocator — decides which EP ranks hold which experts.
+
+Capability parity with the reference ExpertsAllocator/BasicExpertsAllocator
+(legacy/vescale/moe/experts_allocator.py:26,63): the reference dynamically
+assigns each expert a DP x TP submesh based on load; here the allocation is
+a *ragged unit vector over the ep mesh dim* (experts per rank), which lowers
+to a RaggedShard placement of the stacked expert params.  Reallocation is a
+ragged->ragged redistribute (all-to-all-v) — see MoEParamBuffer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ExpertsAllocator", "BasicExpertsAllocator"]
+
+
+class ExpertsAllocator:
+    """Base allocator: uniform static assignment."""
+
+    def __init__(self, num_experts: int, ep_size: int):
+        if num_experts % ep_size != 0 and ep_size > num_experts:
+            raise ValueError(f"{num_experts} experts over {ep_size} ranks")
+        self.num_experts = num_experts
+        self.ep_size = ep_size
+
+    def allocate(self, load: Optional[Sequence[float]] = None) -> Tuple[int, ...]:
+        """experts-per-rank units (sum == num_experts)."""
+        base = self.num_experts // self.ep_size
+        rem = self.num_experts % self.ep_size
+        return tuple(base + (1 if r < rem else 0) for r in range(self.ep_size))
+
+
+class BasicExpertsAllocator(ExpertsAllocator):
+    """Load-aware allocator (reference BasicExpertsAllocator:63): given
+    per-expert load (token counts / EMA), greedily assigns contiguous expert
+    ranges so per-rank total load is balanced — lighter-loaded experts pack
+    more per rank.  Collective cost stays one all-to-all-v on refresh."""
+
+    def allocate(self, load: Optional[Sequence[float]] = None) -> Tuple[int, ...]:
+        if load is None:
+            return super().allocate()
+        load = np.asarray(load, dtype=np.float64)
+        if load.shape != (self.num_experts,):
+            raise ValueError(f"load must have shape ({self.num_experts},)")
+        load = np.maximum(load, 1e-9)
+        target = load.sum() / self.ep_size
+        units = [0] * self.ep_size
+        r, acc = 0, 0.0
+        for e in range(self.num_experts):
+            # keep at least (remaining ranks - 1) experts for later ranks
+            remaining_experts = self.num_experts - e
+            remaining_ranks = self.ep_size - r
+            if r < self.ep_size - 1 and acc >= target * (r + 1) and remaining_experts > remaining_ranks - 1:
+                if units[r] > 0:
+                    r += 1
+            units[r] += 1
+            acc += load[e]
+        # guarantee no empty rank when experts >= ranks
+        if self.num_experts >= self.ep_size:
+            for r in range(self.ep_size):
+                if units[r] == 0:
+                    donor = int(np.argmax(units))
+                    units[donor] -= 1
+                    units[r] += 1
+        assert sum(units) == self.num_experts
+        return tuple(units)

@@ -1,0 +1,89 @@
+"""MoE layer — top-k routed expert MLPs.
+
+Model side of the reference's moe/ package (the reference reuses HF mixtral
+modules; legacy/examples/mixtral_4D_benchmark).  TPU-native formulation:
+capacity-based dense dispatch/combine einsums (Mesh-TensorFlow / GSPMD MoE
+pattern) so the token exchange lowers to XLA all-to-all over the ``ep`` mesh
+axis when experts are Shard(0)-placed — no per-token host logic, fully
+jit/MXU friendly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+__all__ = ["MoEConfig", "MoEMLP"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    d_model: int = 64
+    d_ff: int = 256
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    router_jitter: float = 0.0
+    aux_loss_coef: float = 0.01
+    dtype: Any = jnp.float32
+
+
+class MoEMLP(nn.Module):
+    """Top-k gated expert MLP bank (SwiGLU-free GELU variant).
+
+    Returns (y, aux_loss).  Dispatch/combine are dense one-hot einsums with
+    per-expert capacity C = ceil(k * N / E * capacity_factor); dropped tokens
+    (over capacity) pass through the residual (standard Switch/Mixtral
+    behavior)."""
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        x2 = x.reshape(-1, d)  # (N, d)
+        N = x2.shape[0]
+        E, K = c.num_experts, c.top_k
+
+        router = self.param(
+            "router", nn.initializers.lecun_normal(), (d, E), jnp.float32
+        )
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(), (E, d, c.d_ff), c.dtype
+        )
+        b_in = self.param("b_in", nn.initializers.zeros, (E, c.d_ff), c.dtype)
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(), (E, c.d_ff, d), c.dtype
+        )
+        b_out = self.param("b_out", nn.initializers.zeros, (E, d), c.dtype)
+
+        logits = (x2.astype(jnp.float32) @ router)  # (N, E) fp32 routing
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, K)  # (N, K)
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+        from .token_dispatcher import TokenDispatcher
+
+        C = TokenDispatcher.capacity_for(N, E, K, c.capacity_factor)
+        td = TokenDispatcher(E, C)
+        disp, comb = td.build_masks(gate_idx, gate_vals)  # (N,E,C) fp32
+
+        xe = td.dispatch(x2.astype(c.dtype), disp)  # (E, C, d)
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, w_in) + b_in[:, None, :])
+        ye = jnp.einsum("ecf,efd->ecd", h, w_out) + b_out[:, None, :]
+        y = td.combine(ye, comb)  # (N, d)
+
+        # load-balancing aux loss (Switch): mean router prob x fraction of
+        # tokens whose top-k includes the expert
+        expert_onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # (N,K,E)
+        me = jnp.mean(probs, axis=0)  # (E,)
+        ce = jnp.mean(jnp.max(expert_onehot, axis=1).astype(jnp.float32), axis=0)
+        aux = c.aux_loss_coef * E * jnp.sum(me * ce)
+
+        return y.reshape(orig_shape).astype(x.dtype), aux

@@ -1,0 +1,57 @@
+"""MoEOptimizer — optimizer over ragged expert buffers with state migration.
+
+Capability parity with the reference MoEOptimizer
+(legacy/vescale/moe/moe_optimizer.py:40): runs the inner optimizer on each
+rank's local expert shard and, when the allocator re-assigns experts,
+redistributes the optimizer state alongside the params
+(_moe_param_buffer.py refresh path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import jax
+import optax
+
+from ..darray import DArray
+from .moe_param_buffer import MoEParamBuffer
+
+__all__ = ["MoEOptimizer"]
+
+
+class MoEOptimizer:
+    def __init__(self, optimizer: optax.GradientTransformation, buffer: MoEParamBuffer):
+        self.tx = optimizer
+        self.buffer = buffer
+
+    # DArray pytrees flow through optax untouched (DArray is a pytree whose
+    # leaf is the physical array; elementwise optax math keeps the layout)
+    def init(self, sharded_params):
+        return self.tx.init(sharded_params)
+
+    def step(self, sharded_params, opt_state, sharded_grads):
+        updates, opt_state = self.tx.update(sharded_grads, opt_state, sharded_params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: DArray(p.data + u.data, p.spec) if isinstance(p, DArray) else p + u,
+            sharded_params,
+            updates,
+            is_leaf=lambda x: isinstance(x, DArray),
+        )
+        return new_params, opt_state
+
+    def refresh(self, sharded_params, opt_state, new_units: Sequence[int]) -> Tuple[MoEParamBuffer, Any, Any]:
+        """Reallocate experts: migrate params AND optimizer state
+        (reference refresh_buffer + optimizer-state redistribution)."""
+        new_buffer, new_params = self.buffer.refresh(sharded_params, new_units)
+
+        def move(leaf):
+            if isinstance(leaf, DArray):
+                from ..redistribute import redistribute
+
+                return redistribute(leaf, new_buffer._placement(leaf.shape))
+            return leaf
+
+        new_state = jax.tree_util.tree_map(move, opt_state, is_leaf=lambda x: isinstance(x, DArray))
+        self.buffer = new_buffer
+        return new_buffer, new_params, new_state

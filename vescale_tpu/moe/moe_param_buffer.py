@@ -1,0 +1,91 @@
+"""MoEParamBuffer — expert params as a ragged buffer over the ep mesh dim.
+
+Capability parity with the reference MoEParamBuffer / MoELayerParamBuffer
+(legacy/vescale/moe/_moe_param_buffer.py:405,50): batched all-gather /
+reduce-scatter of expert params and optimizer-state redistribution when the
+allocator changes the expert->rank assignment (refresh_buffer,
+_moe_param_buffer.py:183).
+
+TPU-native: expert params (leaves shaped (E, ...)) flatten expert-major into
+one buffer per leaf with a RaggedShard whose units are
+experts_per_rank * expert_leaf_size.  Reallocation = ragged->ragged
+redistribute, which compiles to all-to-all-v (spec.py layout algebra) — the
+reference's hand-built optimizer-state migration collapses into the same
+redistribute applied to each state leaf.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..darray import DArray, distribute_tensor
+from ..mesh import DeviceMesh
+from ..placements import RaggedShard, Replicate
+from ..redistribute import redistribute
+from ..spec import DArraySpec, TensorMeta
+
+__all__ = ["MoEParamBuffer"]
+
+
+class MoEParamBuffer:
+    """Holds a pytree of expert params (every leaf leading dim == E) as
+    ragged DArrays over ``ep_dim`` with ``units`` experts per rank."""
+
+    def __init__(self, mesh: DeviceMesh, ep_dim: str, num_experts: int, units: Sequence[int]):
+        self.mesh = mesh
+        self.ep_dim = ep_dim
+        self.ep_index = mesh._dim_index(ep_dim)
+        self.num_experts = num_experts
+        self.units = tuple(int(u) for u in units)
+        if sum(self.units) != num_experts:
+            raise ValueError(f"units {units} != num_experts {num_experts}")
+
+    def _placement(self, leaf_shape) -> List:
+        per_expert = int(np.prod(leaf_shape[1:])) if len(leaf_shape) > 1 else 1
+        units = tuple(u * per_expert for u in self.units)
+        placements = [Replicate()] * self.mesh.ndim
+        placements[self.ep_index] = RaggedShard(tuple(range(len(leaf_shape))), units)
+        return placements
+
+    # ----------------------------------------------------------- pack/own
+    def shard_params(self, expert_params) -> Any:
+        """pytree of (E, ...) arrays -> pytree of ragged DArrays."""
+        return jax.tree_util.tree_map(
+            lambda leaf: distribute_tensor(leaf, self.mesh, self._placement(leaf.shape)),
+            expert_params,
+        )
+
+    def gather_params(self, sharded) -> Any:
+        """ragged DArrays -> full (E, ...) arrays (all-gather-v;
+        run_all_gather parity, _moe_param_buffer.py:384)."""
+        return jax.tree_util.tree_map(
+            lambda d: d.full_tensor().reshape(d.shape),
+            sharded,
+            is_leaf=lambda x: isinstance(x, DArray),
+        )
+
+    def local_experts(self, rank: int) -> Tuple[int, int]:
+        """(first_expert, count) owned by flat ep-rank ``rank``."""
+        coord = self.mesh.coordinate_of_rank(rank)
+        r = coord[self.ep_index]
+        start = sum(self.units[:r])
+        return start, self.units[r]
+
+    # ------------------------------------------------------------ refresh
+    def refresh(self, sharded, new_units: Sequence[int]) -> Tuple["MoEParamBuffer", Any]:
+        """Migrate to a new expert->rank assignment (reference
+        refresh_buffer, _moe_param_buffer.py:183): ragged->ragged
+        redistribute (all-to-all-v) on every leaf.  Apply to optimizer state
+        trees too (MoEOptimizer.refresh)."""
+        new_buf = MoEParamBuffer(self.mesh, self.ep_dim, self.num_experts, new_units)
+
+        def one(d: DArray):
+            return redistribute(d, new_buf._placement(d.shape))
+
+        return new_buf, jax.tree_util.tree_map(
+            one, sharded, is_leaf=lambda x: isinstance(x, DArray)
+        )

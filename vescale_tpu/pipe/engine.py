@@ -73,13 +73,20 @@ class PipeEngine:
 
         Returns (mean_loss, grads_per_group) — grads aligned with
         ``params_per_group`` and shared-group grads already synced
-        (reference engine/pipe.py:138 forward_backward)."""
+        (reference engine/pipe.py:138 forward_backward).  In
+        ``forward_only`` mode returns (mean_loss_or_None, last_stage_outputs)
+        and 'target' may be omitted from the minibatch."""
         M = num_microbatches or 1
         G = self.module.num_groups
         micro = self._split_microbatches(
             {k: v for k, v in minibatch.items() if k != "target"}, M
         )
-        targets = self._split_microbatches({"target": minibatch["target"]}, M)
+        has_target = "target" in minibatch
+        if not has_target and not forward_only:
+            raise ValueError("training forward_backward requires a 'target' in the minibatch")
+        targets = (
+            self._split_microbatches({"target": minibatch["target"]}, M) if has_target else None
+        )
         schedule = build_schedule(self.plan, M)
         if forward_only:
             schedule = [
@@ -92,6 +99,7 @@ class PipeEngine:
         cotangents: Dict[Tuple[int, int], Any] = {}  # (g, m) -> dy for group g
         wgrad_stash: Dict[Tuple[int, int], Any] = {}
         losses: Dict[int, Any] = {}
+        outputs: Dict[int, Any] = {}  # forward-only: last-group outputs per microbatch
         grads: List[Optional[Dict[str, Any]]] = [None] * G
 
         def ready(ins: Instruction) -> bool:
@@ -111,14 +119,18 @@ class PipeEngine:
             g = self.module.group_index(ins.stage, ins.chunk)
             m = ins.microbatch
             if ins.kind == InstructionKind.FORWARD:
-                x = micro[m]["input"] if g == 0 else acts[(g - 1, m)]
+                # the producing entry is consumed exactly once: evict so peak
+                # memory under 1F1B stays O(stages), not O(stages*microbatches)
+                x = micro[m]["input"] if g == 0 else acts.pop((g - 1, m))
                 fwd = self.module.group_forward(g)
                 if forward_only:
                     # no linearization / residuals in inference mode
                     if g == G - 1:
-                        loss = self.loss_fn(fwd(params_per_group[g], x), targets[m]["target"])
-                        losses[m] = loss
-                        acts[(g, m)] = loss
+                        y = fwd(params_per_group[g], x)
+                        outputs[m] = y
+                        if targets is not None:
+                            losses[m] = self.loss_fn(y, targets[m]["target"])
+                        acts[(g, m)] = y
                     else:
                         acts[(g, m)] = fwd(params_per_group[g], x)
                 elif g == G - 1:
@@ -168,7 +180,10 @@ class PipeEngine:
 
         mean_loss = sum(losses.values()) / M if losses else None
         if forward_only:
-            return mean_loss, None
+            outs = (
+                jnp.concatenate([outputs[m] for m in range(M)], axis=0) if outputs else None
+            )
+            return mean_loss, outs
         grads = self.module.sync_shared_params_grads([g if g is not None else {} for g in grads])
         return mean_loss, grads
 

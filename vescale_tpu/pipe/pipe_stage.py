@@ -144,7 +144,13 @@ def _cuts_by_weight(weights: List[float], n: int) -> List[int]:
             cuts.append(k)
         acc += w
     while len(cuts) < n - 1:
-        cuts.append(len(weights) - (n - 1 - len(cuts)))
+        cuts.append(len(weights))
+    # repair pass: cuts must be strictly increasing with >= 1 unit per group
+    # (weight concentrated at the end can otherwise produce empty groups)
+    for i in range(n - 1):
+        lo = (cuts[i - 1] if i > 0 else 0) + 1
+        hi = len(weights) - (n - 1 - i)
+        cuts[i] = min(max(cuts[i], lo), hi)
     return cuts
 
 
