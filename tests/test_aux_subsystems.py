@@ -1,0 +1,247 @@
+"""Tests for auxiliary subsystems: VeDeviceMesh, deferred init, loss
+parallel, model patches, auto-plan, ndtimeline, CommDebugMode, emulator
+(mirrors reference legacy/test/{parallel/devicemesh_api,dmp,ndtimeline,
+emulator,dtensor/loss} suites)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import vescale_tpu as vt
+from vescale_tpu.placements import Replicate, Shard
+
+
+# ------------------------------------------------------------ VeDeviceMesh
+def test_vedevicemesh_api():
+    from vescale_tpu.devicemesh_api import VeDeviceMesh
+
+    vdm = VeDeviceMesh()
+    vdm.init_device_mesh("cpu", (2, 2, 2), mesh_dim_names=("PP", "DP", "TP"))
+    assert vdm.size() == 8 and vdm.ndim == 3
+    assert vdm.get_strategy_coordinate(5) == (1, 0, 1)
+    assert vdm.lookup_rank("TP") == 0
+    assert vdm.is_first_stage() and not (vdm.get_pipeline_parallel_rank() == 1)
+    tp_meshes = vdm.get_global_tensor_parallel_meshes()
+    assert len(tp_meshes) == 4 and tp_meshes[0].size() == 2
+    with pytest.raises(RuntimeError):
+        vdm.init_device_mesh("cpu", (8,), mesh_dim_names=("DP",), check_uniqueness=True)
+
+
+# ----------------------------------------------------------- deferred init
+def test_deferred_init(mesh2d):
+    from vescale_tpu.initialize import deferred_init, is_deferred, materialize_dtensor
+
+    aval = deferred_init(lambda k: jax.random.normal(k, (8, 4)), jax.random.key(0))
+    assert is_deferred(aval) and aval.shape == (8, 4)
+    d = materialize_dtensor(
+        lambda k: jax.random.normal(k, (8, 4)), mesh2d, [Shard(0)], jax.random.key(0)
+    )
+    assert isinstance(d, vt.DArray) and d.shape == (8, 4)
+    golden = jax.random.normal(jax.random.key(0), (8, 4))
+    np.testing.assert_array_equal(np.asarray(d.full_tensor()), np.asarray(golden))
+
+
+# ----------------------------------------------------------- loss parallel
+def test_vocab_parallel_cross_entropy(mesh1d):
+    from vescale_tpu.loss import vocab_parallel_cross_entropy
+
+    logits = jax.random.normal(jax.random.key(0), (4, 6, 64))
+    targets = jax.random.randint(jax.random.key(1), (4, 6), 0, 64)
+    dense = vocab_parallel_cross_entropy(logits, targets)
+    sharded = vocab_parallel_cross_entropy(logits, targets, mesh=mesh1d, vocab_dim_name="tp")
+    np.testing.assert_allclose(float(dense), float(sharded), rtol=1e-6)
+    # label smoothing runs
+    sm = vocab_parallel_cross_entropy(logits, targets, label_smoothing=0.1)
+    assert np.isfinite(float(sm))
+
+
+# ------------------------------------------------------------ model patches
+def test_model_patches(mesh2d):
+    from vescale_tpu.model.patch import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelCrossEntropy,
+        VocabParallelEmbedding,
+        patch_method,
+    )
+
+    x = jax.random.normal(jax.random.key(0), (2, 8))
+    col = ColumnParallelLinear(16, mesh=mesh2d)
+    v = col.init(jax.random.key(1), x)
+    y = col.apply(v, x)
+    assert y.shape == (2, 16)
+    row = RowParallelLinear(8, mesh=mesh2d)
+    v2 = row.init(jax.random.key(2), y)
+    z = row.apply(v2, y)
+    assert z.shape == (2, 8)
+
+    emb = VocabParallelEmbedding(64, 16, mesh=mesh2d)
+    ve = emb.init(jax.random.key(3), jnp.ones((2, 4), jnp.int32))
+    e = emb.apply(ve, jnp.array([[1, 2], [3, 4]]))
+    assert e.shape == (2, 2, 16)
+
+    vce = VocabParallelCrossEntropy(mesh=None)
+    loss = vce.init_with_output(jax.random.key(4), jax.random.normal(jax.random.key(5), (2, 3, 64)),
+                                jnp.zeros((2, 3), jnp.int32))[0]
+    assert np.isfinite(float(loss))
+
+    class T:
+        def f(self):
+            return 1
+
+    undo = patch_method(T, "f", lambda self: 2)
+    assert T().f() == 2
+    undo()
+    assert T().f() == 1
+
+
+# ---------------------------------------------------------------- auto-plan
+def test_auto_parallelize_module(mesh2d):
+    from vescale_tpu.dmp import auto_parallelize_module
+    from vescale_tpu.models.nanogpt import GPT, GPTConfig
+
+    cfg = GPTConfig(block_size=16, vocab_size=64, n_layer=2, n_head=2, n_embd=32)
+    model = GPT(cfg)
+    idx = jnp.ones((2, 8), jnp.int32)
+    dm = auto_parallelize_module(model, mesh2d, idx)
+    variables = dm.init(jax.random.key(0), idx)
+    k = variables["params"]["h_0"]["attn"]["c_attn"]["kernel"]
+    assert "tp" in str(k.sharding.spec)  # col-parallel derived automatically
+    p = variables["params"]["h_0"]["attn"]["c_proj"]["kernel"]
+    assert "tp" in str(p.sharding.spec)  # row-parallel derived automatically
+    out = dm.apply(variables, idx)
+    golden = model.apply(variables, idx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------- ndtimeline
+def test_ndtimeline(tmp_path):
+    from vescale_tpu.ndtimeline import (
+        ChromeTraceHandler,
+        LocalRawHandler,
+        flush,
+        inc_step,
+        init_ndtimers,
+        ndtimeit,
+    )
+
+    trace_path = str(tmp_path / "trace.json")
+    chrome = ChromeTraceHandler(trace_path)
+    raw = LocalRawHandler(str(tmp_path / "raw.jsonl"))
+    init_ndtimers(rank=0, handlers=[chrome, raw])
+    with ndtimeit("forward-compute"):
+        _ = jnp.sum(jnp.ones((64, 64))).block_until_ready()
+    inc_step()
+    with ndtimeit("backward-compute", tags={"mb": 1}):
+        pass
+    spans = flush()
+    assert len(spans) == 2 and spans[1].step == 1
+    chrome.write()
+    data = json.loads(open(trace_path).read())
+    assert len(data["traceEvents"]) == 2
+    assert data["traceEvents"][0]["name"] == "forward-compute"
+    assert os.path.getsize(str(tmp_path / "raw.jsonl")) > 0
+
+
+# ------------------------------------------------------------ CommDebugMode
+def test_comm_debug_mode(mesh2d):
+    from vescale_tpu.debug import CommDebugMode, comm_counts
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = jax.device_put(jnp.ones((8, 8)), NamedSharding(mesh2d.jax_mesh, P("tp", None)))
+
+    def f(x):
+        # contraction over sharded dim -> all-reduce (or reduce-scatter)
+        y = x.T @ x
+        return jax.lax.with_sharding_constraint(y, NamedSharding(mesh2d.jax_mesh, P()))
+
+    counts = comm_counts(f, x)
+    assert counts["total"] >= 1
+    assert counts["all_reduce"] + counts["reduce_scatter"] + counts["all_gather"] >= 1
+
+    with CommDebugMode() as cdm:
+        out = cdm.trace(f, x)
+    assert cdm.get_total_counts() == counts["total"]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.ones((8, 8)).T @ jnp.ones((8, 8))))
+
+
+# -------------------------------------------------------------- debug logger
+def test_debug_logger(capsys, monkeypatch):
+    from vescale_tpu.debug import DebugLogger
+
+    monkeypatch.setenv("VESCALE_DEBUG_MODE", "1")
+    DebugLogger.update_vescale_debug_mode_from_env()
+    DebugLogger._stream = __import__("sys").stdout
+    DebugLogger.log_communication("all_reduce", "shape=(4,)")
+    out = capsys.readouterr().out
+    assert "all_reduce" in out
+    monkeypatch.setenv("VESCALE_DEBUG_MODE", "0")
+    DebugLogger.update_vescale_debug_mode_from_env()
+    DebugLogger.log_operator("matmul")
+    assert "matmul" not in capsys.readouterr().out
+
+
+# ------------------------------------------------------------------ emulator
+def test_emulator_ring_vs_math():
+    from vescale_tpu.emulator import Emulator
+
+    em = Emulator(4)
+    rng = np.random.default_rng(0)
+    locals_ = [rng.normal(size=(13,)).astype(np.float32) for _ in range(4)]
+    out = em.ring_all_reduce(locals_)
+    # all ranks bitwise-identical? ring gives each rank the same reduced
+    # chunks assembled identically
+    for o in out[1:]:
+        np.testing.assert_array_equal(out[0], o)
+    # and matches the mathematical sum to fp tolerance
+    np.testing.assert_allclose(out[0], np.sum(locals_, axis=0), rtol=1e-5, atol=1e-6)
+    tree = em.tree_all_reduce(locals_)
+    np.testing.assert_allclose(tree[0], np.sum(locals_, axis=0), rtol=1e-5, atol=1e-6)
+    # all_to_all
+    a2a = em.all_to_all([np.arange(4) + 10 * r for r in range(4)])
+    np.testing.assert_array_equal(a2a[1], np.array([1, 11, 21, 31]))
+
+
+def test_emulator_vs_xla(mesh2d):
+    from vescale_tpu.emulator import verify_all_reduce_against_xla
+
+    mesh = vt.DeviceMesh(("tp",), (4,))
+    rng = np.random.default_rng(1)
+    locals_ = [rng.normal(size=(16,)).astype(np.float32) for _ in range(4)]
+    bitwise, diff = verify_all_reduce_against_xla(mesh, locals_, "sum", "ring")
+    # reduction-order divergence must be tiny; bitwise flag reports exactness
+    assert diff < 1e-5
+    from vescale_tpu.emulator.mesh_collectives import emulate_mesh_all_reduce
+
+    out = emulate_mesh_all_reduce(locals_ * 2, mesh2d, mesh_dim="tp")
+    assert len(out) == 8
+
+
+def test_comm_counts_async_not_double(mesh2d):
+    """regression: all-reduce-start/-done pairs count once."""
+    from vescale_tpu.debug.comm_mode import _OPCODE_RE, _COLLECTIVE_OPCODES
+
+    line1 = "%all-gather-start.1 = (f32[4], f32[16]) all-gather-start(%p), dimensions={0}"
+    line2 = "%all-gather-done.1 = f32[16] all-gather-done(%all-gather-start.1)"
+    ops1 = [t for t in _OPCODE_RE.findall(line1)]
+    ops2 = [t for t in _OPCODE_RE.findall(line2)]
+    assert "all-gather-start" in ops1
+    assert ops2 == ["all-gather-done"]
+    assert any(any(t in ops for t in ops1) for ops in _COLLECTIVE_OPCODES.values())
+    assert not any(any(t in ops for t in ops2) for ops in _COLLECTIVE_OPCODES.values())
+
+
+def test_sharded_label_smoothing_matches_dense(mesh1d):
+    from vescale_tpu.loss import vocab_parallel_cross_entropy
+
+    logits = jax.random.normal(jax.random.key(0), (2, 4, 64))
+    targets = jax.random.randint(jax.random.key(1), (2, 4), 0, 64)
+    dense = vocab_parallel_cross_entropy(logits, targets, label_smoothing=0.1)
+    sharded = vocab_parallel_cross_entropy(
+        logits, targets, mesh=mesh1d, vocab_dim_name="tp", label_smoothing=0.1
+    )
+    np.testing.assert_allclose(float(dense), float(sharded), rtol=1e-6)

@@ -36,7 +36,22 @@ from .darray import (
 from .redistribute import redistribute, redistribute_local_tensor
 from .api import vescale_all_gather, vescale_all_reduce, vescale_reduce_scatter
 from .random import manual_seed, get_rng_tracker
+from .loss import loss_parallel, vocab_parallel_cross_entropy
+from .devicemesh_api import VeDeviceMesh, VESCALE_DEVICE_MESH
+from .dmodule import parallelize_module
+from .initialize import deferred_init, materialize_dtensor, materialize_dparameter
 from . import collectives
+
+# heavier subsystems are plain submodules:
+#   vescale_tpu.parallel    (DDP / DistributedOptimizer / FSDP)
+#   vescale_tpu.pipe        (pipeline parallel)  + vescale_tpu.plan
+#   vescale_tpu.moe         (expert parallel)
+#   vescale_tpu.checkpoint  (distributed save/load + reshard)
+#   vescale_tpu.ndtimeline  (profiler)
+#   vescale_tpu.emulator    (bitwise collective replay)
+#   vescale_tpu.debug       (CommDebugMode / DebugLogger)
+#   vescale_tpu.dmp         (auto-plan)
+#   vescale_tpu.models      (nanoGPT / llama / mixtral)
 
 # DTensor-compatible aliases for migration from the reference API
 DTensor = DArray

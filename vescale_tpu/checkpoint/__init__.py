@@ -1,0 +1,184 @@
+"""vescale_tpu.checkpoint — distributed save/load with online reshard.
+
+Capability parity with the reference checkpoint package
+(legacy/vescale/checkpoint/__init__.py:16,35 save/load;
+api/vescale_checkpointer.py:71; save_state_dict.py:36; load_state_dict.py:27):
+
+  vescale_tpu.checkpoint.save(path, {"model": params, "optimizer": state},
+                              async_checkpoint=True)
+  state = vescale_tpu.checkpoint.load(path, {"model": template, ...})
+
+Features (reference parity): per-chunk sharded writes deduped across
+replicas, plan caching, async io workers, in-memory storage backend, and
+load-time ONLINE RESHARD — the template's shardings may differ arbitrarily
+from the saved run's (DP/TP/PP/mesh-size changes, dense <-> ragged), for
+model and optimizer state alike (checkpoint/README.md:37-41,
+optim/checkpoint_helper.py).
+
+TPU-native: chunks are logical-index-space boxes (spec.py layout algebra),
+so resharding is pure box intersection + slice reads — no collectives on
+load (each host reads exactly the bytes it needs; the reference's
+DP-rank-0-broadcast optimization is subsumed by the shared filesystem /
+memory store in the single-controller model).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..darray import DArray, from_local
+from ..spec import DArraySpec, TensorMeta
+from .planner import SavePlanner, array_chunks, array_plan, fetch_chunk, flatten_state, key_of_path
+from .reshard import Box, dense_to_flat_ranges, intersect
+from .storage import AsyncWriter, FileSystemStorage, MemoryStorage, Storage, bytes_to_array
+
+__all__ = ["save", "load", "CheckpointHandle", "FileSystemStorage", "MemoryStorage"]
+
+_PLANNER = SavePlanner()
+_MEM_STORES: Dict[str, MemoryStorage] = {}
+
+
+def _storage_for(path: str) -> Storage:
+    if path.startswith("mem://"):
+        return _MEM_STORES.setdefault(path, MemoryStorage())
+    return FileSystemStorage(path)
+
+
+class CheckpointHandle:
+    """Async-save handle (reference async_checkpoint=True semantics)."""
+
+    def __init__(self, writer: AsyncWriter):
+        self._writer = writer
+
+    def wait(self) -> None:
+        self._writer.shutdown()
+
+
+def save(
+    path: str,
+    checkpoint_state: Dict[str, Any],
+    async_checkpoint: bool = False,
+    num_io_workers: int = 4,
+) -> Optional[CheckpointHandle]:
+    """Save a state dict of pytrees (reference checkpoint/__init__.py:16).
+
+    Leaves may be DArray, sharded jax.Array, numpy, or python scalars."""
+    storage = _storage_for(path)
+    writer = AsyncWriter(storage, num_io_workers)
+    meta: Dict[str, Any] = {"arrays": {}}
+
+    for top_key, tree in checkpoint_state.items():
+        flat = flatten_state(tree)
+        # plan caching (reference lookup_plan_meta, vescale_planner.py:116):
+        # the chunk layout is deterministic given the state-dict signature
+        sig = _PLANNER.plan_signature(flat)
+        plans = _PLANNER.lookup(sig)
+        if plans is None:
+            plans = [(key, *array_plan(leaf)) for key, leaf in flat]
+            _PLANNER.store(sig, plans)
+        for (key, shape, dtype, chunk_plan), (_k, leaf) in zip(plans, flat):
+            full_key = f"{top_key}/{key}"
+            entry = {"shape": list(shape), "dtype": dtype, "chunks": []}
+            for i, (box, owner) in enumerate(chunk_plan):
+                fname = f"data/{full_key}/{i}.npy"
+                entry["chunks"].append({**box.to_json(), "file": fname})
+                writer.submit(fname, fetch_chunk(leaf, box, owner))
+            meta["arrays"][full_key] = entry
+
+    # meta.json is the commit marker: it must hit storage only after every
+    # data chunk is durable, so a reader never sees a torn checkpoint
+    def _finalize(data_futures):
+        for f in data_futures:
+            f.result()
+        storage.write_bytes("meta.json", json.dumps(meta).encode())
+
+    data_futures = list(writer.futures)
+    writer.futures = [writer.pool.submit(_finalize, data_futures)]
+    if async_checkpoint:
+        return CheckpointHandle(writer)
+    writer.shutdown()
+    return None
+
+
+def _assemble(entry, storage: Storage, target_leaf):
+    """Read + reshard one array for ``target_leaf``'s layout."""
+    shape = tuple(entry["shape"])
+    dtype = np.dtype(entry["dtype"])
+    saved = [(Box.from_json(c), c["file"]) for c in entry["chunks"]]
+
+    # Assemble the FULL logical array from chunks, then lay it out as the
+    # target wants.  (Single-controller: the full value is addressable; a
+    # multi-host runtime would assemble only the local boxes — the chunk
+    # math supports it via intersect/dense_to_flat_ranges.)
+    full = np.zeros(shape, dtype)
+    flat_view = full.reshape(-1)
+    for box, fname in saved:
+        data = bytes_to_array(storage.read_bytes(fname))
+        if box.flat:
+            flat_view[box.offset[0]: box.offset[0] + box.size[0]] = data.reshape(-1)
+        elif box.size == ():
+            full[()] = data.reshape(())
+        else:
+            sl = tuple(slice(o, o + s) for o, s in zip(box.offset, box.size))
+            full[sl] = data.reshape(box.size)
+    return full
+
+
+def load(path: str, checkpoint_state: Dict[str, Any], broadcast_checkpoint: bool = False) -> Dict[str, Any]:
+    """Load into the layout described by ``checkpoint_state`` (a template
+    pytree of DArray/jax.Array/np leaves — values are ignored, shardings are
+    the contract).  Returns a new state dict with loaded values
+    (reference load, checkpoint/__init__.py:35; online reshard per
+    README.md:37-41)."""
+    storage = _storage_for(path)
+    meta = json.loads(storage.read_bytes("meta.json").decode())
+    out: Dict[str, Any] = {}
+    for top_key, tree in checkpoint_state.items():
+        flat_with_path = jax.tree_util.tree_flatten_with_path(
+            tree, is_leaf=lambda x: isinstance(x, DArray)
+        )
+        leaves = []
+        for kp, leaf in flat_with_path[0]:
+            full_key = f"{top_key}/{key_of_path(kp)}"
+            if full_key not in meta["arrays"]:
+                raise KeyError(f"checkpoint at {path} has no array {full_key}")
+            entry = meta["arrays"][full_key]
+            full = _assemble(entry, storage, leaf)
+            leaves.append(_relayout(full, leaf))
+        out[top_key] = jax.tree_util.tree_unflatten(flat_with_path[1], leaves)
+    return out
+
+
+def _relayout(full: np.ndarray, target_leaf):
+    """Place the full logical value into the target leaf's layout."""
+    from ..darray import distribute_tensor
+
+    if isinstance(target_leaf, DArray):
+        if tuple(full.shape) != tuple(target_leaf.shape):
+            raise ValueError(
+                f"shape mismatch: saved {full.shape} vs template {target_leaf.shape} "
+                "(resharding changes layout, not logical shape)"
+            )
+        return distribute_tensor(
+            full.astype(np.dtype(target_leaf.dtype)), target_leaf.mesh, target_leaf.placements
+        )
+    if isinstance(target_leaf, jax.Array):
+        val = jnp.asarray(full, dtype=target_leaf.dtype)
+        if tuple(val.shape) != tuple(target_leaf.shape):
+            raise ValueError(f"shape mismatch: saved {val.shape} vs template {target_leaf.shape}")
+        from jax.sharding import NamedSharding
+
+        if isinstance(target_leaf.sharding, NamedSharding):
+            return jax.device_put(val, target_leaf.sharding)
+        # single-device/uncommitted leaves (e.g. optimizer step counters):
+        # keep uncommitted so jit may co-locate them with the params
+        return val
+    arr = np.asarray(full)
+    if np.isscalar(target_leaf) or (hasattr(target_leaf, "ndim") and target_leaf.ndim == 0):
+        return arr.reshape(()).item() if not hasattr(target_leaf, "dtype") else arr.reshape(())
+    return arr

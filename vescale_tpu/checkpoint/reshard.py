@@ -1,0 +1,113 @@
+"""Chunk-box math for checkpoint save/load resharding.
+
+Capability parity with the reference's load-time resharding
+(legacy/vescale/checkpoint/planner/vescale/vescale_planner.py:64
+create_default_local_load_plan — intersect saved chunks with the current
+DTensorSpec) and the ragged chunk math of
+vescale/dtensor/vescale_utils/checkpoint.py:70 (_break_ragged_box).
+
+A *box* is (offsets, sizes) in the logical global index space of one array.
+Ragged chunks are boxes over the flattened space (flat=True).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Box", "intersect", "chunks_for_spec"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Box:
+    offset: Tuple[int, ...]
+    size: Tuple[int, ...]
+    flat: bool = False  # offsets/sizes in the flattened index space
+
+    @property
+    def nelems(self) -> int:
+        n = 1
+        for s in self.size:
+            n *= s
+        return n
+
+    def to_json(self):
+        return {"offset": list(self.offset), "size": list(self.size), "flat": self.flat}
+
+    @staticmethod
+    def from_json(d) -> "Box":
+        return Box(tuple(d["offset"]), tuple(d["size"]), bool(d.get("flat", False)))
+
+
+def intersect(a: Box, b: Box) -> Optional[Box]:
+    """Intersection of two same-space boxes (None if empty).  Mixed
+    flat/dense boxes are intersected in the flat space by the caller after
+    flattening (see ``_flatten_box``)."""
+    if a.flat != b.flat:
+        raise ValueError("boxes live in different index spaces; flatten first")
+    off, size = [], []
+    for (ao, asz), (bo, bsz) in zip(zip(a.offset, a.size), zip(b.offset, b.size)):
+        lo, hi = max(ao, bo), min(ao + asz, bo + bsz)
+        if lo >= hi:
+            return None
+        off.append(lo)
+        size.append(hi - lo)
+    return Box(tuple(off), tuple(size), a.flat)
+
+
+def dense_to_flat_ranges(box: Box, shape: Sequence[int]) -> List[Tuple[int, int]]:
+    """A dense box as a list of contiguous (start, length) runs in the
+    flattened row-major space (used to intersect dense saves with ragged
+    loads — the reference's _break_ragged_box)."""
+    if box.flat:
+        return [(box.offset[0], box.size[0])]
+    if not shape:
+        return [(0, 1)]
+    strides = [1] * len(shape)
+    for d in range(len(shape) - 2, -1, -1):
+        strides[d] = strides[d + 1] * shape[d + 1]
+    # j = last dim not fully covered; all dims after j are full, so one run
+    # spans size[j] * prod(shape[j+1:]) elements
+    j = 0
+    for d in range(len(shape) - 1, -1, -1):
+        if not (box.offset[d] == 0 and box.size[d] == shape[d]):
+            j = d
+            break
+    run = box.size[j] * strides[j]
+    ranges: List[Tuple[int, int]] = []
+    idx = [0] * j  # odometer over dims 0..j-1
+    while True:
+        start = box.offset[j] * strides[j]
+        start += sum((box.offset[d] + idx[d]) * strides[d] for d in range(j))
+        ranges.append((int(start), int(run)))
+        d = j - 1
+        while d >= 0:
+            idx[d] += 1
+            if idx[d] < box.size[d]:
+                break
+            idx[d] = 0
+            d -= 1
+        if d < 0 or j == 0:
+            break
+    return ranges
+
+
+def chunks_for_spec(spec) -> List[Tuple[Box, int]]:
+    """Unique owned chunks of a DArraySpec with their owning flat rank,
+    deduped across replicated mesh dims — the save-side WriteItems of the
+    reference planner (one mesh sweep; owner = first rank holding the box)."""
+    mesh = spec.mesh
+    seen = {}
+    for r in range(mesh.size()):
+        coord = mesh.coordinate_of_rank(r)
+        if spec.has_ragged():
+            size, off = spec.ragged_local_chunk(coord)
+            box = Box((off,), (size,), flat=True)
+        else:
+            shape, offs = spec.local_chunk(coord)
+            box = Box(tuple(offs), tuple(shape))
+        if box.nelems > 0 and box not in seen:
+            seen[box] = r
+    return list(seen.items())

@@ -1,0 +1,140 @@
+"""Checkpoint storage — filesystem + in-memory backends, async writers.
+
+Capability parity with the reference storage stack:
+  - FileSystemWriter + async io workers <- legacy/vescale/checkpoint/
+    storage/filesystem.py (880 LoC; _OverlappingCpuLoader pinned-mem D2H)
+  - bfile storage abstraction          <- utilities/bfile.py
+  - in-memory file service             <- utilities/server/mem_server_lib.py
+    (gRPC server replaced by an in-process store — a TPU pod's controller
+    shares the process; cross-host serving is the driver's concern)
+
+TPU-native notes: D2H is ``np.asarray`` on an addressable shard (jax manages
+pinned staging); write parallelism via a thread pool (the reference's io
+workers).  Data files are raw little-endian buffers + one JSON metadata
+index per checkpoint.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as _fut
+import io
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Storage", "FileSystemStorage", "MemoryStorage", "AsyncWriter"]
+
+
+class Storage:
+    """bfile-style minimal storage interface."""
+
+    def write_bytes(self, name: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read_bytes(self, name: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def list(self) -> List[str]:
+        raise NotImplementedError
+
+
+class FileSystemStorage(Storage):
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _p(self, name: str) -> str:
+        p = os.path.join(self.root, name)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        return p
+
+    def write_bytes(self, name: str, data: bytes) -> None:
+        tmp = self._p(name) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._p(name))
+
+    def read_bytes(self, name: str) -> bytes:
+        with open(os.path.join(self.root, name), "rb") as f:
+            return f.read()
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(os.path.join(self.root, name))
+
+    def list(self) -> List[str]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for fn in files:
+                out.append(os.path.relpath(os.path.join(dirpath, fn), self.root))
+        return out
+
+
+class MemoryStorage(Storage):
+    """In-process memory store (reference mem_server_lib without the gRPC
+    transport).  Thread-safe; used for fast async checkpoints and tests."""
+
+    def __init__(self):
+        self._data: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def write_bytes(self, name: str, data: bytes) -> None:
+        with self._lock:
+            self._data[name] = bytes(data)
+
+    def read_bytes(self, name: str) -> bytes:
+        with self._lock:
+            return self._data[name]
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            return name in self._data
+
+    def list(self) -> List[str]:
+        with self._lock:
+            return list(self._data)
+
+
+def array_to_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.lib.format.write_array(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def bytes_to_array(data: bytes) -> np.ndarray:
+    return np.lib.format.read_array(io.BytesIO(data), allow_pickle=False)
+
+
+class AsyncWriter:
+    """Thread-pool chunk writer (reference async io workers,
+    filesystem.py).  ``submit`` enqueues a write; ``wait`` drains."""
+
+    def __init__(self, storage: Storage, num_workers: int = 4):
+        self.storage = storage
+        # >= 2 workers: the checkpoint finalize task blocks one worker while
+        # waiting on data writes, which need another to make progress
+        self.pool = _fut.ThreadPoolExecutor(max_workers=max(2, num_workers))
+        self.futures: List[_fut.Future] = []
+
+    def submit(self, name: str, arr: np.ndarray) -> None:
+        data = array_to_bytes(arr)  # D2H + serialize on the caller thread
+        self.futures.append(self.pool.submit(self.storage.write_bytes, name, data))
+
+    def write_json(self, name: str, obj) -> None:
+        self.futures.append(
+            self.pool.submit(self.storage.write_bytes, name, json.dumps(obj).encode())
+        )
+
+    def wait(self) -> None:
+        for f in self.futures:
+            f.result()
+        self.futures.clear()
+
+    def shutdown(self) -> None:
+        self.wait()
+        self.pool.shutdown()

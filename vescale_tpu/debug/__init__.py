@@ -1,0 +1,3 @@
+from .debug_log import DebugLogger
+from .comm_mode import CommDebugMode, comm_counts
+from . import pdb

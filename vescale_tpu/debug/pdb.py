@@ -1,0 +1,28 @@
+"""Distributed-friendly pdb (reference legacy/vescale/debug/pdb.py):
+break only on a chosen process, with stdin redirected to the tty."""
+
+from __future__ import annotations
+
+import os
+import pdb
+import sys
+
+__all__ = ["ForkedPdb", "set_trace"]
+
+
+class ForkedPdb(pdb.Pdb):
+    """Pdb that works from forked/spawned worker processes."""
+
+    def interaction(self, *args, **kwargs):
+        _stdin = sys.stdin
+        try:
+            sys.stdin = open("/dev/stdin")
+            super().interaction(*args, **kwargs)
+        finally:
+            sys.stdin = _stdin
+
+
+def set_trace(rank: int = 0, current_rank: int = 0) -> None:
+    """Break only on ``rank`` (single-controller: process index)."""
+    if current_rank == rank:
+        ForkedPdb().set_trace(sys._getframe().f_back)

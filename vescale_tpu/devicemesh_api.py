@@ -1,0 +1,129 @@
+"""VeDeviceMesh — the global nD-mesh singleton API.
+
+Capability parity with the reference VeDeviceMesh
+(legacy/vescale/devicemesh_api/api.py:28,48,188,221,290-361,380-388,475):
+one process-global mesh with named strategy dims (PP/DP/TP/...) and
+convenience rank/submesh lookups used by the trainers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+from .mesh import DeviceMesh, init_device_mesh as _init
+
+__all__ = ["VeDeviceMesh", "VESCALE_DEVICE_MESH"]
+
+
+class VeDeviceMesh:
+    PP, DP, TP = "PP", "DP", "TP"
+
+    def __init__(self) -> None:
+        self._mesh: Optional[DeviceMesh] = None
+
+    # ------------------------------------------------------------- init
+    def init_device_mesh(
+        self,
+        device_type: str = "tpu",
+        mesh_shape: Sequence[int] = (),
+        mesh_dim_names: Optional[Sequence[str]] = None,
+        check_uniqueness: bool = False,
+    ) -> DeviceMesh:
+        """(reference api.py:48) — create & register the global mesh."""
+        if check_uniqueness and self._mesh is not None:
+            raise RuntimeError("device mesh already initialized")
+        self._mesh = _init(device_type, mesh_shape, mesh_dim_names=mesh_dim_names)
+        return self._mesh
+
+    def get(self) -> DeviceMesh:
+        if self._mesh is None:
+            raise RuntimeError("call init_device_mesh first")
+        return self._mesh
+
+    @property
+    def ndim(self) -> int:
+        return self.get().ndim
+
+    def size(self, dim: Optional[Union[int, str]] = None) -> int:
+        return self.get().size(dim)
+
+    # ------------------------------------------------------ coordinates
+    def get_strategy_coordinate(self, local_rank: Optional[int] = None) -> Tuple[int, ...]:
+        """(api.py:188) n-D coordinate of a flat rank."""
+        mesh = self.get()
+        r = local_rank if local_rank is not None else mesh.get_rank()
+        return mesh.coordinate_of_rank(r)
+
+    def lookup_rank(self, dim: Union[int, str]) -> int:
+        """(api.py:221) this process's index along one strategy dim."""
+        mesh = self.get()
+        return self.get_strategy_coordinate()[mesh._dim_index(dim)]
+
+    def get_local_rank(self) -> int:
+        return self.get().get_rank()
+
+    # ------------------------------------------------- PP/DP/TP helpers
+    def _dim_or_none(self, name: str):
+        mesh = self.get()
+        lowered = [d.lower() for d in mesh.mesh_dim_names]
+        return lowered.index(name.lower()) if name.lower() in lowered else None
+
+    def get_pipeline_parallel_rank(self) -> int:
+        d = self._dim_or_none("pp")
+        return 0 if d is None else self.get_strategy_coordinate()[d]
+
+    def get_data_parallel_rank(self) -> int:
+        d = self._dim_or_none("dp")
+        return 0 if d is None else self.get_strategy_coordinate()[d]
+
+    def get_tensor_parallel_rank(self) -> int:
+        d = self._dim_or_none("tp")
+        return 0 if d is None else self.get_strategy_coordinate()[d]
+
+    def get_pipeline_parallel_mesh(self) -> DeviceMesh:
+        return self.get()["pp" if self._dim_or_none("pp") is not None else self.get().mesh_dim_names[0]]
+
+    def get_data_parallel_mesh(self) -> DeviceMesh:
+        return self.get()["dp" if self._dim_or_none("dp") is not None else self.get().mesh_dim_names[0]]
+
+    def get_tensor_parallel_mesh(self) -> DeviceMesh:
+        return self.get()["tp" if self._dim_or_none("tp") is not None else self.get().mesh_dim_names[-1]]
+
+    def get_global_tensor_parallel_meshes(self):
+        """All TP submeshes (api.py:290-361)."""
+        mesh = self.get()
+        import numpy as np
+
+        tp_dim = self._dim_or_none("tp")
+        if tp_dim is None:
+            return [mesh]
+        out = []
+        other_shape = [s for i, s in enumerate(mesh.shape) if i != tp_dim]
+        for flat in range(int(np.prod(other_shape)) if other_shape else 1):
+            coord = list(np.unravel_index(flat, other_shape)) if other_shape else []
+            index = []
+            k = 0
+            for i in range(mesh.ndim):
+                if i == tp_dim:
+                    index.append(slice(None))
+                else:
+                    index.append(int(coord[k]))
+                    k += 1
+            sub = mesh.devices[tuple(index)]
+            from jax.sharding import Mesh as JaxMesh
+
+            out.append(DeviceMesh((mesh.mesh_dim_names[tp_dim],), _jax_mesh=JaxMesh(sub, axis_names=(mesh.mesh_dim_names[tp_dim],))))
+        return out
+
+    def is_first_stage(self) -> bool:
+        """(api.py:380)"""
+        return self.get_pipeline_parallel_rank() == 0
+
+    def is_last_stage(self) -> bool:
+        """(api.py:388)"""
+        d = self._dim_or_none("pp")
+        n = 1 if d is None else self.get().shape[d]
+        return self.get_pipeline_parallel_rank() == n - 1
+
+
+VESCALE_DEVICE_MESH = VeDeviceMesh()

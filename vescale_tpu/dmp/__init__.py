@@ -1,0 +1,2 @@
+from .dmp import auto_parallelize_module, PlanGenerator
+from .policies.registry import register_policy, get_policy

@@ -1,0 +1,45 @@
+"""auto_parallelize_module (reference legacy/vescale/dmp/dmp.py:185) —
+zero-plan entry point: derive the sharding plan from the model itself via a
+policy, then parallelize.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from ..dmodule.api import DModule, parallelize_module
+from ..mesh import DeviceMesh
+from .policies.registry import get_policy
+from . import policies  # noqa: F401  (registers built-ins)
+
+__all__ = ["auto_parallelize_module", "PlanGenerator"]
+
+
+class PlanGenerator:
+    """(reference dmp.py:61) — policy-driven plan derivation from an
+    abstract init."""
+
+    def __init__(self, policy: str = "MEGATRON"):
+        self.policy = policy
+
+    def generate(self, module, mesh: DeviceMesh, *example_args, **example_kwargs):
+        abstract = jax.eval_shape(
+            lambda: module.init(jax.random.key(0), *example_args, **example_kwargs)
+        )
+        params = abstract.get("params", abstract)
+        return get_policy(self.policy)(params, mesh)
+
+
+def auto_parallelize_module(
+    module,
+    device_mesh: DeviceMesh,
+    *example_args,
+    policy: str = "MEGATRON",
+    **example_kwargs,
+) -> DModule:
+    """One-call parallelization: introspect -> plan -> parallelize_module
+    (reference auto_parallelize_module, dmp.py:185)."""
+    plan = PlanGenerator(policy).generate(module, device_mesh, *example_args, **example_kwargs)
+    return parallelize_module(module, device_mesh, plan)

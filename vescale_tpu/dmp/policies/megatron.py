@@ -1,0 +1,91 @@
+"""MEGATRON auto-plan policy (reference legacy/vescale/dmp/policies/
+megatron.py:33-218: mlp/attention/layernorm/embedding/lm-head/dropout
+providers).
+
+The reference introspects torch module classes; TPU-native introspection
+walks the *abstract param tree* (names + shapes), classifying each 2-D
+kernel as column- or row-parallel by Megatron naming conventions and pairing
+within a block: projections INTO the hidden bottleneck are rows, expansions
+are columns.  Falls back to replicate when unsure — always correct, just
+not sharded.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Tuple
+
+import jax
+
+from ...placements import Replicate, Shard
+from .registry import register_policy
+
+_COL_HINTS = (
+    "c_attn", "q_proj", "k_proj", "v_proj", "query", "key", "value",
+    "c_fc", "gate_proj", "up_proj", "fc1", "w1", "w3", "wi",
+)
+_ROW_HINTS = ("c_proj", "o_proj", "down_proj", "fc2", "w2", "wo", "dense_4h_to_h", "out_proj")
+_EMBED_HINTS = ("embedding",)
+_HEAD_HINTS = ("lm_head",)
+_NORM_HINTS = ("ln", "layernorm", "norm")
+
+
+def _path_str(kp) -> str:
+    return ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+
+
+@register_policy("MEGATRON")
+def megatron_policy(abstract_params, mesh, tp_dim: str = "tp", dp_dim: str = "dp") -> Dict[str, Any]:
+    """Derive {parameter, forward} plans from param names/shapes."""
+    names = mesh.mesh_dim_names
+    tp_i = names.index(tp_dim) if tp_dim in names else None
+    n_tp = mesh.shape[tp_i] if tp_i is not None else 1
+
+    def pl(shard_dim=None):
+        out: List[Any] = [Replicate()] * mesh.ndim
+        if shard_dim is not None and tp_i is not None:
+            out[tp_i] = Shard(shard_dim)
+        return out
+
+    param_plan: Dict[str, Any] = {}
+
+    def classify(kp, leaf):
+        path = _path_str(kp)
+        low = path.lower()
+        key = re.escape(path)
+        shape = tuple(leaf.shape)
+        if any(h in low for h in _NORM_HINTS) or len(shape) == 0:
+            param_plan[key] = pl()
+            return leaf
+        if low.endswith(".embedding") or any(h in low for h in _HEAD_HINTS):
+            # hidden- or vocab-shard if divisible
+            d = 1 if len(shape) > 1 and shape[1] % n_tp == 0 else None
+            param_plan[key] = pl(d)
+            return leaf
+        if len(shape) == 2 and low.endswith("kernel"):
+            parent = low.rsplit(".", 2)[-2] if "." in low else low
+            if any(h in parent for h in _COL_HINTS) and shape[1] % n_tp == 0:
+                param_plan[key] = pl(1)
+                return leaf
+            if any(h in parent for h in _ROW_HINTS) and shape[0] % n_tp == 0:
+                param_plan[key] = pl(0)
+                return leaf
+            param_plan[key] = pl()
+            return leaf
+        if len(shape) == 1 and low.endswith("bias"):
+            parent = low.rsplit(".", 2)[-2] if "." in low else low
+            if any(h in parent for h in _COL_HINTS) and shape[0] % n_tp == 0:
+                param_plan[key] = pl(0)
+                return leaf
+            param_plan[key] = pl()
+            return leaf
+        param_plan[key] = pl()
+        return leaf
+
+    jax.tree_util.tree_map_with_path(classify, abstract_params)
+    dp_i = names.index(dp_dim) if dp_dim in names else None
+    root_in = [Replicate()] * mesh.ndim
+    if dp_i is not None:
+        root_in[dp_i] = Shard(0)
+    fwd_plan = {r"": {"input": [root_in], "output": [root_in]}}
+    return {"parameter": param_plan, "forward": fwd_plan}

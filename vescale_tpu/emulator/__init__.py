@@ -1,0 +1,3 @@
+from .core import Emulator, EmulatorProcessGroup, init_process_group
+from .verify import verify_all_reduce_against_xla
+from . import mesh_collectives

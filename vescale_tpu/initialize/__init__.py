@@ -1,0 +1,1 @@
+from .deferred_init import deferred_init, is_deferred, materialize_dtensor, materialize_dparameter, materialize_module

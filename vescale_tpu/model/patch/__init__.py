@@ -1,0 +1,4 @@
+from .vp_embedding import VocabParallelEmbedding
+from .vp_cross_entropy import VocabParallelCrossEntropy
+from .linear import RowParallelLinear, ColumnParallelLinear
+from .monkey_patch import patch_method

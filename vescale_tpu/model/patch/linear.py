@@ -1,0 +1,71 @@
+"""Row/Column-parallel linear modules (reference legacy/vescale/model/patch/
+linear.py:32,56 — the RowParallelLinear forward rewrite that defers the
+partial-sum all-reduce).
+
+TPU-native: the modules annotate kernel layouts; XLA places the all-reduce
+(row) / activation split (column) and fuses it with neighbors — the
+reference's hand-deferred resharding is the default compiler behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...mesh import DeviceMesh
+
+__all__ = ["RowParallelLinear", "ColumnParallelLinear"]
+
+
+class ColumnParallelLinear(nn.Module):
+    features: int
+    mesh: Optional[DeviceMesh] = None
+    tp_dim_name: str = "tp"
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        k = self.param(
+            "kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.features), self.dtype
+        )
+        if self.mesh is not None:
+            k = jax.lax.with_sharding_constraint(
+                k, NamedSharding(self.mesh.jax_mesh, P(None, self.tp_dim_name))
+            )
+        y = x @ k
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros, (self.features,), self.dtype)
+            if self.mesh is not None:
+                b = jax.lax.with_sharding_constraint(
+                    b, NamedSharding(self.mesh.jax_mesh, P(self.tp_dim_name))
+                )
+            y = y + b
+        return y
+
+
+class RowParallelLinear(nn.Module):
+    features: int
+    mesh: Optional[DeviceMesh] = None
+    tp_dim_name: str = "tp"
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        k = self.param(
+            "kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.features), self.dtype
+        )
+        if self.mesh is not None:
+            k = jax.lax.with_sharding_constraint(
+                k, NamedSharding(self.mesh.jax_mesh, P(self.tp_dim_name, None))
+            )
+        y = x @ k  # contraction over the sharded dim -> XLA all-reduces
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros, (self.features,), self.dtype)
+            y = y + b  # bias added once, after the reduce (linear.py:56)
+        return y

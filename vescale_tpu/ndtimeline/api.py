@@ -1,0 +1,57 @@
+"""ndtimeline public API (reference legacy/vescale/ndtimeline/api.py:72
+init_ndtimers, :318 flush, :293 wait, :309 inc_step)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from .timer import NDTimerManager
+from .world_info import WorldInfo
+
+__all__ = ["init_ndtimers", "flush", "wait", "inc_step", "ndtimeit", "ndtimer", "get_manager"]
+
+_MANAGER: Optional[NDTimerManager] = None
+
+
+def get_manager() -> NDTimerManager:
+    global _MANAGER
+    if _MANAGER is None:
+        _MANAGER = NDTimerManager()
+    return _MANAGER
+
+
+def init_ndtimers(rank: int = 0, mesh=None, handlers=(), max_spans: int = 100_000) -> NDTimerManager:
+    """(api.py:72) — create the global manager, register handlers."""
+    global _MANAGER
+    _MANAGER = NDTimerManager(rank=rank, max_spans=max_spans)
+    if mesh is not None:
+        _MANAGER.world = WorldInfo.from_mesh(mesh, rank)
+    for h in handlers:
+        _MANAGER.register_handler(h)
+    return _MANAGER
+
+
+def flush(step_range=None, next_iteration: bool = False):
+    """(api.py:318)"""
+    return get_manager().flush()
+
+
+def wait() -> None:
+    """(api.py:293)"""
+    get_manager().wait()
+
+
+def inc_step(n: int = 1) -> None:
+    """(api.py:309)"""
+    get_manager().inc_step(n)
+
+
+def ndtimeit(metric: str, tags=None):
+    """Context manager: with ndtimeit("forward-compute"): ..."""
+    return get_manager().timeit(metric, tags)
+
+
+def ndtimer(metric: str):
+    """Decorator form."""
+    return get_manager().decorator(metric)

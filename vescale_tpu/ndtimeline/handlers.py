@@ -1,0 +1,78 @@
+"""Span handlers (reference legacy/vescale/ndtimeline/handlers/):
+ChromeTraceHandler (chrome_trace_event.py — perfetto/chrome JSON),
+LoggingHandler, LocalRawHandler (local_raw_handler.py)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from .timer import Span
+
+__all__ = ["ChromeTraceHandler", "LoggingHandler", "LocalRawHandler"]
+
+
+class ChromeTraceHandler:
+    """Accumulates spans as chrome://tracing 'X' events; write() emits a
+    perfetto-loadable JSON (reference chrome_trace_event.py)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.events = []
+
+    def __call__(self, spans: List[Span]) -> None:
+        for s in spans:
+            self.events.append(
+                {
+                    "name": s.metric,
+                    "ph": "X",
+                    "ts": s.start * 1e6,
+                    "dur": s.duration * 1e6,
+                    "pid": s.rank,
+                    "tid": s.step,
+                    "args": dict(s.tags or {}, step=s.step),
+                }
+            )
+
+    def write(self) -> str:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "w") as f:
+            json.dump({"traceEvents": self.events, "displayTimeUnit": "ms"}, f)
+        return self.path
+
+
+class LoggingHandler:
+    def __init__(self, log_fn=print):
+        self.log_fn = log_fn
+
+    def __call__(self, spans: List[Span]) -> None:
+        for s in spans:
+            self.log_fn(
+                f"[ndtimeline r{s.rank} step{s.step}] {s.metric}: {s.duration * 1e3:.3f} ms"
+            )
+
+
+class LocalRawHandler:
+    """Appends spans to a local JSONL file (reference local_raw_handler.py)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def __call__(self, spans: List[Span]) -> None:
+        with open(self.path, "a") as f:
+            for s in spans:
+                f.write(
+                    json.dumps(
+                        {
+                            "metric": s.metric,
+                            "start": s.start,
+                            "duration": s.duration,
+                            "step": s.step,
+                            "rank": s.rank,
+                            "tags": s.tags,
+                        }
+                    )
+                    + "\n"
+                )

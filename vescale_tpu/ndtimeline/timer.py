@@ -1,0 +1,111 @@
+"""NDTimerManager — span collection with a global clock.
+
+Capability parity with the reference ndtimeline timer
+(legacy/vescale/ndtimeline/timer.py, 756 LoC: CUDA-event ring buffers +
+calibrated clock; sock_streamer.py multi-process flush).
+
+TPU-native: device timing belongs to the XLA profiler — spans here wrap
+host-side regions and annotate the device trace via ``jax.profiler``
+TraceAnnotation/named_scope so they appear inline in perfetto captures.
+Ring-buffered spans flush to pluggable handlers (handlers.py).  The
+reference's unix-socket streamer process is unnecessary in-process; the
+handler interface is where a remote sink would plug in.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+__all__ = ["Span", "NDTimerManager"]
+
+
+@dataclasses.dataclass
+class Span:
+    metric: str
+    start: float       # host wall-clock (epoch seconds)
+    duration: float
+    step: int
+    rank: int
+    tags: Optional[Dict[str, Any]] = None
+
+
+class NDTimerManager:
+    """Collects spans into a bounded ring buffer; flush() drains to
+    handlers.  Thread-safe; nestable via context managers."""
+
+    def __init__(self, rank: int = 0, max_spans: int = 100_000):
+        self.rank = rank
+        self.step = 0
+        self._spans: collections.deque = collections.deque(maxlen=max_spans)
+        self._lock = threading.Lock()
+        self._handlers: List[Callable[[List[Span]], None]] = []
+        self._calibration_offset = 0.0  # reference's clock calibration hook
+
+    # ------------------------------------------------------------ config
+    def register_handler(self, handler: Callable[[List[Span]], None]) -> None:
+        self._handlers.append(handler)
+
+    def calibrate(self, offset_seconds: float) -> None:
+        """Shift timestamps by a global-clock offset (reference calibration
+        on flush, ndtimeline/README.md:16-20)."""
+        self._calibration_offset = offset_seconds
+
+    # ----------------------------------------------------------- spans
+    def record(self, metric: str, start: float, duration: float, tags=None) -> None:
+        with self._lock:
+            self._spans.append(
+                Span(metric, start + self._calibration_offset, duration, self.step, self.rank, tags)
+            )
+
+    def timeit(self, metric: str, tags=None):
+        """Context manager measuring a host region + annotating the device
+        trace (shows up in XLA profiler captures)."""
+        mgr = self
+
+        class _Ctx:
+            def __enter__(self):
+                self._ann = jax.profiler.TraceAnnotation(metric)
+                self._ann.__enter__()
+                self._t0 = time.time()
+                return self
+
+            def __exit__(self, *exc):
+                dur = time.time() - self._t0
+                self._ann.__exit__(*exc)
+                mgr.record(metric, self._t0, dur, tags)
+                return False
+
+        return _Ctx()
+
+    def decorator(self, metric: str):
+        def deco(fn):
+            def wrapped(*a, **k):
+                with self.timeit(metric):
+                    return fn(*a, **k)
+
+            return wrapped
+
+        return deco
+
+    def inc_step(self, n: int = 1) -> None:
+        self.step += n
+
+    # ----------------------------------------------------------- flush
+    def flush(self) -> List[Span]:
+        with self._lock:
+            spans = list(self._spans)
+            self._spans.clear()
+        for h in self._handlers:
+            h(spans)
+        return spans
+
+    def wait(self) -> None:
+        """Handlers here are synchronous; kept for API parity
+        (reference wait drains the streamer queue, api.py:293)."""
+        return None
