@@ -1,9 +1,13 @@
 """Benchmark — prints ONE JSON line for the driver.
 
-Measures nanoGPT (GPT-2-124M config) train-step throughput + MFU on the
-available chip(s).  The reference publishes no absolute numbers
-(BASELINE.md); the target ladder's north star is MFU >= 45%, so
+Headline: Llama-350M pretrain step at seq 4096 (the north-star config shape
+— llama family, seq 4096 — scaled to the single available chip), bf16,
+pallas flash attention, donated buffers.  The reference publishes no
+absolute numbers (BASELINE.md); the ladder target is MFU >= 45%, so
 ``vs_baseline`` reports MFU / 0.45.
+
+Note: on the axon tunnel ``block_until_ready`` alone does not force
+execution; the loss is host-fetched for true timings.
 """
 
 from __future__ import annotations
@@ -36,29 +40,47 @@ def main():
 
     from vescale_tpu.mesh import DeviceMesh
     from vescale_tpu.dmodule import parallelize_module
-    from vescale_tpu.models.nanogpt import GPT, GPTConfig, cross_entropy_loss, nanogpt_plan
+    from vescale_tpu.models.llama import Llama, LlamaConfig, llama_plan
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
     from vescale_tpu.train import make_train_step
 
     devices = jax.devices()
     n = len(devices)
     on_tpu = devices[0].platform == "tpu"
 
-    B, T = (8, 1024) if on_tpu else (2, 128)
-    cfg = GPTConfig(
-        block_size=T,
-        vocab_size=50304,
-        n_layer=12,
-        n_head=12,
-        n_embd=768,
-        dropout=0.0,
-        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-    )
-    if not on_tpu:
-        cfg = GPTConfig(block_size=T, vocab_size=512, n_layer=2, n_head=4, n_embd=128)
+    if on_tpu:
+        B, T = 2, 4096
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=1024,
+            intermediate_size=2816,
+            num_hidden_layers=24,
+            num_attention_heads=16,
+            num_key_value_heads=16,
+            max_position_embeddings=T,
+            dtype=jnp.bfloat16,
+            # the pallas kernel is not GSPMD-partitionable: single-chip only
+            # (multi-chip attention goes through ulysses/ring shard_map paths)
+            use_flash_attention=(n == 1),
+        )
+        metric = "llama350m_train_MFU_1chip_seq4096"
+    else:
+        B, T = 2, 128
+        cfg = LlamaConfig(
+            vocab_size=512,
+            hidden_size=128,
+            intermediate_size=256,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=4,
+            max_position_embeddings=T,
+            dtype=jnp.float32,
+        )
+        metric = "llama_cpu_smoke_MFU"
 
     mesh = DeviceMesh(("dp", "tp"), (n, 1), devices=devices)
-    model = GPT(cfg)
-    dm = parallelize_module(model, mesh, nanogpt_plan(mesh, sequence_parallel=False))
+    model = Llama(cfg)
+    dm = parallelize_module(model, mesh, llama_plan(mesh, sequence_parallel=False))
     variables = dm.init(jax.random.key(0), jnp.ones((2, T), jnp.int32))
     params = variables["params"]
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
@@ -73,11 +95,9 @@ def main():
     toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B * n, T + 1)), jnp.int32)
     batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
 
-    # warmup / compile (host-fetch the loss: on the axon tunnel
-    # block_until_ready alone does not force execution)
     for _ in range(3):
         params, opt_state, loss = step(params, opt_state, batch)
-        float(loss)
+        float(loss)  # host fetch forces execution on the axon tunnel
 
     iters = 10 if on_tpu else 3
     t0 = time.perf_counter()
@@ -89,19 +109,21 @@ def main():
     tokens_per_step = B * n * T
     tok_s_chip = tokens_per_step / dt / n
     # PaLM-style MFU: 6*P per token + attention 12*L*T*E per token (fwd+bwd)
-    flops_per_token = 6.0 * n_params + 12.0 * cfg.n_layer * T * cfg.n_embd
+    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_hidden_layers * T * cfg.hidden_size
     mfu = flops_per_token * tokens_per_step / dt / (peak_flops_per_chip(devices[0]) * n)
 
     print(
         json.dumps(
             {
-                "metric": "nanogpt124m_train_MFU_1chip" if on_tpu else "nanogpt_cpu_smoke_MFU",
+                "metric": metric,
                 "value": round(mfu, 4),
                 "unit": "MFU",
                 "vs_baseline": round(mfu / 0.45, 4),
                 "tokens_per_sec_per_chip": round(tok_s_chip, 1),
                 "step_time_ms": round(dt * 1e3, 2),
                 "params": n_params,
+                "seq_len": T,
+                "flash_attention": bool(cfg.use_flash_attention),
             }
         )
     )

@@ -44,6 +44,8 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
+    use_flash_attention: bool = True  # pallas fused kernel on TPU
+    remat: bool = False  # jax.checkpoint each block (HBM for FLOPs)
     dtype: Any = jnp.bfloat16
 
     @property
@@ -130,11 +132,16 @@ class LlamaAttention(nn.Module):
             rep = H // KV
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(hd)
-        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
-        att = jnp.where(mask[None, None], att, jnp.finfo(jnp.float32).min)
-        att = jax.nn.softmax(att, axis=-1).astype(c.dtype)
-        y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, H * hd)
+        if c.use_flash_attention:
+            from ..ops.flash_attention import flash_attention
+
+            y = flash_attention(q, k, v, causal=True).reshape(B, T, H * hd)
+        else:
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(hd)
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            att = jnp.where(mask[None, None], att, jnp.finfo(jnp.float32).min)
+            att = jax.nn.softmax(att, axis=-1).astype(c.dtype)
+            y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, H * hd)
         return nn.Dense(E, use_bias=False, dtype=c.dtype, name="o_proj")(y)
 
 
@@ -176,8 +183,9 @@ class Llama(nn.Module):
         emb = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype, name="embed_tokens")
         x = emb(idx)
         positions = jnp.arange(T)[None, :].repeat(B, axis=0)
+        block_cls = nn.remat(LlamaBlock) if c.remat else LlamaBlock
         for i in range(c.num_hidden_layers):
-            x = LlamaBlock(c, name=f"layers_{i}")(x, positions)
+            x = block_cls(c, name=f"layers_{i}")(x, positions)
         x = RMSNorm(c.rms_norm_eps, c.dtype, name="norm")(x)
         if c.tie_word_embeddings:
             return emb.attend(x)

@@ -35,6 +35,7 @@ class GPTConfig:
     n_embd: int = 768
     dropout: float = 0.0
     bias: bool = True
+    use_flash_attention: bool = False  # pallas kernel (no attn dropout)
     dtype: Any = jnp.float32
 
 
@@ -51,12 +52,17 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(B, T, H, E // H)
         k = k.reshape(B, T, H, E // H)
         v = v.reshape(B, T, H, E // H)
-        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(E // H)
-        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
-        att = jnp.where(mask[None, None, :, :], att, jnp.finfo(att.dtype).min)
-        att = jax.nn.softmax(att, axis=-1)
-        att = nn.Dropout(c.dropout, deterministic=deterministic)(att)
-        y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, E)
+        if c.dropout == 0.0 and c.use_flash_attention:
+            from ..ops.flash_attention import flash_attention
+
+            y = flash_attention(q, k, v, causal=True).reshape(B, T, E)
+        else:
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(E // H)
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            att = jnp.where(mask[None, None, :, :], att, jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att, axis=-1)
+            att = nn.Dropout(c.dropout, deterministic=deterministic)(att)
+            y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, E)
         y = nn.Dense(E, use_bias=c.bias, dtype=c.dtype, name="c_proj")(y)
         return nn.Dropout(c.dropout, deterministic=deterministic)(y)
 

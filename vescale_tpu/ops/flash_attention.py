@@ -1,0 +1,284 @@
+"""Flash attention — fused Pallas TPU kernels (forward + backward).
+
+The hot op of every model family (SURVEY §6 ladder).  Forward streams K/V
+blocks through the MXU with online-softmax accumulation in fp32 and saves
+the per-row logsumexp; backward runs the standard flash decomposition as two
+kernels (dq over q-blocks; dk/dv over kv-blocks) recomputing probabilities
+from the saved LSE — the T x T score matrix never touches HBM in either
+direction, so activation memory is O(T * D).
+
+Falls back to a pure-jnp implementation off-TPU (and uses the pallas
+interpreter in tests), numerically identical math.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is TPU-only at runtime; import lazily-safe
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/where VPU-safe
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, D)
+    D = q.shape[-1]
+
+    nk_total = seq_len // block_k
+    if causal:
+        last = (qi * block_q + block_q - 1) // block_k + 1
+        nk = jnp.minimum(nk_total, last)
+    else:
+        nk = nk_total
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # (1, block_q, 1) block: trailing singleton satisfies TPU tiling rules
+    lse_ref[0] = (m + jnp.log(l_safe))[:, None]
+
+
+def _flash_fwd_pallas(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+    BH, T, D = q3.shape
+    grid = (BH, T // block_q)
+    return pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=T
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+            jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ),
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+# ----------------------------------------------------------------- backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]    # (block_q,)
+    delta = delta_ref[0, :, 0]  # (block_q,)
+    D = q.shape[-1]
+    nk_total = seq_len // block_k
+    if causal:
+        last = (qi * block_q + block_q - 1) // block_k + 1
+        nk = jnp.minimum(nk_total, last)
+    else:
+        nk = nk_total
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_len):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # (block_k, D)
+    v = v_ref[0].astype(jnp.float32)
+    D = k.shape[-1]
+    nq_total = seq_len // block_q
+    if causal:
+        first = (ki * block_k) // block_q  # earliest q block on/after diagonal
+    else:
+        first = 0
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # (block_q, block_k)
+        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(
+        first, nq_total, body, (jnp.zeros((block_k, D), jnp.float32), jnp.zeros((block_k, D), jnp.float32))
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q3, k3, v3, o3, do3, lse, scale, causal, block_q, block_k, interpret):
+    BH, T, D = q3.shape
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1, keepdims=True)  # (BH, T, 1)
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=T)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **kw),
+        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        grid=(BH, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **kw),
+        out_shape=(
+            jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+            jax.ShapeDtypeStruct(v3.shape, v3.dtype),
+        ),
+        grid=(BH, T // block_k),
+        in_specs=[
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+        ),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- reference
+def _dense_ref(q, k, v, scale, causal):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+
+# ------------------------------------------------------------- custom vjp
+def _to3(x):
+    B, T, H, D = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, T, D)
+
+
+def _from3(x, B, H):
+    BH, T, D = x.shape
+    return jnp.transpose(x.reshape(B, H, T, D), (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    B, T, H, D = q.shape
+    o3, lse = _flash_fwd_pallas(_to3(q), _to3(k), _to3(v), scale, causal, block_q, block_k, interpret)
+    return _from3(o3, B, H), (q, k, v, _from3(o3, B, H), lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    B, T, H, D = q.shape
+    dq3, dk3, dv3 = _flash_bwd_pallas(
+        _to3(q), _to3(k), _to3(v), _to3(o), _to3(g), lse, scale, causal, block_q, block_k, interpret
+    )
+    return _from3(dq3, B, H), _from3(dk3, B, H), _from3(dv3, B, H)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Fused attention over (B, T, H, D) q/k/v.  GQA callers repeat K/V
+    heads first (as models/llama does).  Divisibility: T % block sizes == 0
+    (pad upstream); off-TPU falls back to the jnp reference."""
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if interpret is None:
+        interpret = False  # off-TPU default = dense fallback, NOT interpreter
+    if not _HAS_PALLAS or (not on_tpu and not interpret):
+        return _dense_ref(q, k, v, scale, causal)
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        return _dense_ref(q, k, v, scale, causal)
+    return _flash(q, k, v, scale, causal, block_q, block_k, interpret)

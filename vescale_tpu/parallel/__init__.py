@@ -7,3 +7,4 @@ from .optimizer import (
     muon,
 )
 from .fsdp import FSDPParamBuffer, fsdp_plan
+from .context import ring_self_attention, ulysses_self_attention, blockwise_attention

@@ -1,0 +1,245 @@
+"""Context parallelism — ring attention + Ulysses sequence parallelism.
+
+The reference covers long context only via Megatron-style SP activation
+sharding (SURVEY §2.3: "CP / ring attention / Ulysses — ABSENT in
+reference"); for a TPU-native framework long-context is first-class: the
+sequence dim shards across a ``sp`` mesh dim and attention runs without ever
+materializing the full sequence on one chip.
+
+  * ``ring_self_attention`` — blockwise attention with K/V blocks rotating
+    around the ICI ring (lax.ppermute), online-softmax accumulation in fp32
+    (flash-attention style running max/denominator), causal masking by
+    global block offsets.  Compute/communication overlap comes from XLA's
+    scheduler pipelining the permute with the block matmuls.
+  * ``ulysses_self_attention`` — all-to-all resharding seq->heads before
+    attention and heads->seq after (DeepSpeed-Ulysses pattern): each chip
+    sees the FULL sequence for H/n heads, so any attention kernel (incl.
+    pallas flash) drops in unchanged.
+
+Both are differentiable (ppermute/all-to-all transpose cleanly) and
+compose with DP/TP via partial-manual shard_map (other mesh dims stay
+auto/GSPMD).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..collectives import shard_map
+from ..mesh import DeviceMesh
+
+__all__ = ["ring_self_attention", "ulysses_self_attention", "blockwise_attention"]
+
+
+def _online_block(q, k, v, mask, scale, m_prev, l_prev, o_prev):
+    """One KV-block update of the online-softmax accumulator (fp32)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)  # (B,H,Tq)
+    m_new = jnp.maximum(m_prev, m_blk)
+    # guard fully-masked rows (m == -inf): exp(-inf - -inf) -> treat as 0
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    o_new = o_prev * alpha[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32)
+    )
+    return m_new, l_new, o_new
+
+
+def ring_self_attention(
+    q,
+    k,
+    v,
+    mesh: DeviceMesh,
+    sp_dim: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+):
+    """Attention over a seq-sharded (B, T, H, D) q/k/v.  Each of the n sp
+    ranks holds a contiguous T/n block; K/V blocks rotate n-1 times around
+    the ring.  Returns (B, T, H, D) with the same seq sharding."""
+    B, T, H, D = q.shape
+    n = mesh.size(sp_dim)
+    if T % n != 0:
+        raise ValueError(f"seq len {T} not divisible by sp={n}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    fn = _ring_fn(mesh, sp_dim, (B, T, H, D), causal, float(scale))
+    return fn(q, k, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_fn(mesh: DeviceMesh, sp_dim: str, shape, causal: bool, scale: float):
+    """Build + jit the ring program once per (mesh, shape, flags) — eager
+    call sites reuse the compiled executable instead of retracing."""
+    B, T, H, D = shape
+    n = mesh.size(sp_dim)
+    ax = mesh.dim_name(sp_dim)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(q_l, k_l, v_l):
+        # locals: (B, T/n, H, D)
+        t = q_l.shape[1]
+        idx = jax.lax.axis_index(ax)
+        q_pos = idx * t + jnp.arange(t)  # (t,)
+
+        m0 = jnp.full((B, H, t), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((B, H, t), jnp.float32)
+        o0 = jnp.zeros((B, H, t, D), jnp.float32)
+
+        def compute(r, m, l, o, k_cur, v_cur):
+            src = (idx - r) % n  # which rank's kv block we now hold
+            if causal:
+                k_pos = src * t + jnp.arange(t)
+                mask = q_pos[None, None, :, None] >= k_pos[None, None, None, :]
+            else:
+                mask = None
+            return _online_block(q_l, k_cur, v_cur, mask, scale, m, l, o)
+
+        def step(r, carry):
+            m, l, o, k_cur, v_cur = carry
+            m, l, o = compute(r, m, l, o, k_cur, v_cur)
+            k_nxt = jax.lax.ppermute(k_cur, ax, perm)
+            v_nxt = jax.lax.ppermute(v_cur, ax, perm)
+            return m, l, o, k_nxt, v_nxt
+
+        # n-1 compute+rotate steps, final compute without the wasted permute
+        m, l, o, k_last, v_last = jax.lax.fori_loop(0, n - 1, step, (m0, l0, o0, k_l, v_l))
+        m, l, o = compute(n - 1, m, l, o, k_last, v_last)
+        l = jnp.where(l == 0.0, 1.0, l)
+        out = (o / l[..., None]).astype(q_l.dtype)  # (B,H,t,D)
+        return jnp.transpose(out, (0, 2, 1, 3))  # (B,t,H,D)
+
+    spec = P(None, ax)
+    # partial-manual shard_map with manual-axis out_specs requires a jit
+    # context (eager tracing rejects it); jit also caches the executable
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh.jax_mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+            axis_names=frozenset({ax}),
+        )
+    )
+
+
+def ulysses_self_attention(
+    q,
+    k,
+    v,
+    mesh: DeviceMesh,
+    sp_dim: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    attn_fn=None,
+):
+    """All-to-all sequence parallelism (Ulysses): reshard (B, T/n, H, D) ->
+    (B, T, H/n, D), run full-sequence attention on H/n heads, reshard back.
+    ``attn_fn(q, k, v, causal, scale)`` may be any full-attention kernel
+    (defaults to the dense reference; drop in the pallas flash kernel)."""
+    B, T, H, D = q.shape
+    n = mesh.size(sp_dim)
+    if T % n != 0 or H % n != 0:
+        raise ValueError(f"seq {T} and heads {H} must divide sp={n}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    fn = _ulysses_fn(mesh, sp_dim, causal, float(scale), attn_fn)
+    return fn(q, k, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _ulysses_fn(mesh: DeviceMesh, sp_dim: str, causal: bool, scale: float, attn_fn):
+    """Cached compiled ulysses program.  NOTE: a non-default ``attn_fn``
+    must be a stable (module-level) function for the cache to hit."""
+    ax = mesh.dim_name(sp_dim)
+    attn_fn = attn_fn or _dense_attention
+
+    def body(q_l, k_l, v_l):
+        # (B, T/n, H, D) -> (B, T, H/n, D): split heads, gather seq
+        def seq2head(x):
+            return jax.lax.all_to_all(x, ax, split_axis=2, concat_axis=1, tiled=True)
+
+        def head2seq(x):
+            return jax.lax.all_to_all(x, ax, split_axis=1, concat_axis=2, tiled=True)
+
+        qh, kh, vh = seq2head(q_l), seq2head(k_l), seq2head(v_l)
+        out = attn_fn(qh, kh, vh, causal, scale)
+        return head2seq(out)
+
+    spec = P(None, ax)
+    # partial-manual shard_map with manual-axis out_specs requires a jit
+    # context (eager tracing rejects it); jit also caches the executable
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh.jax_mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+            axis_names=frozenset({ax}),
+        )
+    )
+
+
+def _dense_attention(q, k, v, causal: bool, scale: float):
+    T = q.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def blockwise_attention(q, k, v, causal: bool = True, scale: Optional[float] = None, block_size: int = 512):
+    """Single-device blockwise (memory-efficient) attention with the same
+    online-softmax math as the ring — the local building block, useful when
+    T^2 scores don't fit HBM even per-chip.  Structured as scan-over-q-blocks
+    x fori-over-kv-blocks so the traced graph is CONSTANT size in T."""
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    nb = -(-T // block_size)
+    Tp = nb * block_size
+    pad = Tp - T
+    if pad:
+        qp = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    else:
+        qp, kp, vp = q, k, v
+
+    def q_step(_, qi):
+        q_blk = jax.lax.dynamic_slice_in_dim(qp, qi * block_size, block_size, 1)
+        q_pos = qi * block_size + jnp.arange(block_size)
+        m0 = jnp.full((B, H, block_size), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((B, H, block_size), jnp.float32)
+        o0 = jnp.zeros((B, H, block_size, D), jnp.float32)
+
+        def kv_step(ki, carry):
+            m, l, o = carry
+            k_blk = jax.lax.dynamic_slice_in_dim(kp, ki * block_size, block_size, 1)
+            v_blk = jax.lax.dynamic_slice_in_dim(vp, ki * block_size, block_size, 1)
+            k_pos = ki * block_size + jnp.arange(block_size)
+            mask = (q_pos[None, None, :, None] >= k_pos[None, None, None, :]) if causal else None
+            valid = (k_pos < T)[None, None, None, :]  # mask padded kv
+            mask = valid if mask is None else (mask & valid)
+            return _online_block(q_blk, k_blk, v_blk, mask, scale, m, l, o)
+
+        upper = jnp.minimum(qi + 1, nb) if causal else nb
+        m, l, o = jax.lax.fori_loop(0, upper, kv_step, (m0, l0, o0))
+        l = jnp.where(l == 0.0, 1.0, l)
+        return None, jnp.transpose((o / l[..., None]).astype(q.dtype), (0, 2, 1, 3))
+
+    _, outs = jax.lax.scan(q_step, None, jnp.arange(nb))  # (nb, B, blk, H, D)
+    out = jnp.moveaxis(outs, 0, 1).reshape(B, Tp, H, D)
+    return out[:, :T]
