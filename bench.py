@@ -59,8 +59,9 @@ def main():
             num_key_value_heads=16,
             max_position_embeddings=T,
             dtype=jnp.bfloat16,
-            # the pallas kernel is not GSPMD-partitionable: single-chip only
-            # (multi-chip attention goes through ulysses/ring shard_map paths)
+            # the pallas kernel is not GSPMD-partitionable: enable for the
+            # single-chip headline only (multi-chip attention goes through
+            # the ulysses/ring shard_map paths)
             use_flash_attention=(n == 1),
         )
         metric = "llama350m_train_MFU_1chip_seq4096"
@@ -123,7 +124,8 @@ def main():
                 "step_time_ms": round(dt * 1e3, 2),
                 "params": n_params,
                 "seq_len": T,
-                "flash_attention": bool(cfg.use_flash_attention),
+                # the kernel only actually runs on TPU (dense fallback off-TPU)
+                "flash_attention": bool(cfg.use_flash_attention and on_tpu),
             }
         )
     )

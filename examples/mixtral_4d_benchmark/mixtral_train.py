@@ -1,0 +1,94 @@
+"""Mixtral expert-parallel benchmark (reference
+legacy/examples/mixtral_4D_benchmark/mixtral_train.py: --bsz/--seqlen with
+dp x ep/tp mesh).
+
+  python examples/mixtral_4d_benchmark/mixtral_train.py --dp 2 --ep 4 \\
+      --bsz 8 --seqlen 256 --layers 2 --cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# allow running straight from a repo checkout
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--ep", type=int, default=4)
+    ap.add_argument("--bsz", type=int, default=8)
+    ap.add_argument("--seqlen", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--inter", type=int, default=1024)
+    ap.add_argument("--experts", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    import vescale_tpu as vt
+    from vescale_tpu.dmodule import parallelize_module
+    from vescale_tpu.models.mixtral import Mixtral, MixtralConfig, mixtral_plan
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
+
+    mesh = vt.DeviceMesh(("dp", "ep"), (args.dp, args.ep))
+    cfg = MixtralConfig(
+        vocab_size=32000,
+        hidden_size=args.hidden,
+        intermediate_size=args.inter,
+        num_hidden_layers=args.layers,
+        num_attention_heads=8,
+        num_key_value_heads=4,
+        num_local_experts=args.experts,
+        num_experts_per_tok=2,
+        dtype=jnp.float32 if args.cpu else jnp.bfloat16,
+    )
+    model = Mixtral(cfg)
+    dm = parallelize_module(model, mesh, mixtral_plan(mesh))
+    v = dm.init(jax.random.key(0), jnp.ones((2, args.seqlen), jnp.int32))
+    params = v["params"]
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+    print(f"mesh {dict(zip(mesh.mesh_dim_names, mesh.shape))}, params {n_params/1e6:.1f}M")
+    tx = optax.adamw(3e-4)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        def lf(p):
+            logits, aux = dm.apply({"params": p}, batch["input"], mutable=["losses"])
+            return cross_entropy_loss(logits, batch["target"]) + sum(
+                jax.tree_util.tree_leaves(aux["losses"])
+            )
+
+        loss, grads = jax.value_and_grad(lf)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    toks = jax.random.randint(jax.random.key(1), (args.bsz, args.seqlen + 1), 0, cfg.vocab_size)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+    params, opt, loss = step(params, opt, batch)  # compile
+    float(loss)
+    t0 = time.time()
+    for i in range(args.steps):
+        params, opt, loss = step(params, opt, batch)
+    float(loss)
+    dt = (time.time() - t0) / args.steps
+    print(f"loss {float(loss):.4f}, {dt*1e3:.1f} ms/step, {args.bsz*args.seqlen/dt:.0f} tok/s")
+
+
+if __name__ == "__main__":
+    main()

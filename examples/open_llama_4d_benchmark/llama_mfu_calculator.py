@@ -1,0 +1,35 @@
+"""MFU calculator for llama-family runs (reference
+legacy/examples/open_llama_4D_benchmark/llama_mfu_calculator.py:22)."""
+
+from __future__ import annotations
+
+
+def llama_flops_per_token(hidden: int, inter: int, layers: int, vocab: int, seq: int, kv_heads_ratio: float = 1.0) -> float:
+    """Approximate train FLOPs per token (fwd+bwd = 3x fwd, PaLM convention
+    6N + attention)."""
+    attn_proj = 2 * hidden * hidden * (2 + 2 * kv_heads_ratio)  # q,o + k,v (GQA)
+    mlp = 2 * hidden * inter * 3  # gate, up, down
+    attn_scores = 2 * 2 * seq * hidden  # QK^T + PV per token
+    per_layer = attn_proj + mlp + attn_scores
+    head = 2 * hidden * vocab
+    return 3.0 * (layers * per_layer + head)
+
+
+def mfu(tokens_per_sec_per_chip: float, flops_per_token: float, peak_flops: float = 459e12) -> float:
+    return tokens_per_sec_per_chip * flops_per_token / peak_flops
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hidden", type=int, default=4096)
+    ap.add_argument("--inter", type=int, default=11008)
+    ap.add_argument("--layers", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--seq", type=int, default=4096)
+    ap.add_argument("--tok-s-chip", type=float, required=True)
+    ap.add_argument("--peak", type=float, default=459e12, help="bf16 peak (v5p default)")
+    a = ap.parse_args()
+    f = llama_flops_per_token(a.hidden, a.inter, a.layers, a.vocab, a.seq)
+    print(f"FLOPs/token: {f:.3e}  MFU: {mfu(a.tok_s_chip, f, a.peak):.3f}")

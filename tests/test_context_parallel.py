@@ -85,3 +85,11 @@ def test_ring_rejects_indivisible():
     q, k, v = _qkv(jax.random.key(6), T=30)
     with pytest.raises(ValueError):
         ring_self_attention(q, k, v, mesh)
+
+
+def test_blockwise_causal_grads():
+    """regression: causal blockwise attention must be differentiable."""
+    q, k, v = _qkv(jax.random.key(7), T=40)
+    g = jax.grad(lambda q: jnp.sum(blockwise_attention(q, k, v, causal=True, block_size=16) ** 2))(q)
+    g2 = jax.grad(lambda q: jnp.sum(_dense_attention(q, k, v, True, 1.0 / np.sqrt(8)) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g2), rtol=5e-4, atol=5e-4)

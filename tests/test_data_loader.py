@@ -1,0 +1,79 @@
+"""Native (C++) token data loader tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from vescale_tpu.data import TokenDataLoader, build_native
+
+
+@pytest.fixture(scope="module")
+def token_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("data") / "train.bin"
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 50000, 100_000).astype(np.uint16)
+    toks.tofile(p)
+    return str(p), toks
+
+
+def test_native_builds():
+    so = build_native()
+    assert os.path.exists(so)
+
+
+def test_batches_and_targets(token_file):
+    path, toks = token_file
+    dl = TokenDataLoader(path, batch=4, seq_len=64, seed=7)
+    assert dl.num_tokens == 100_000
+    b = dl.next()
+    assert b["input"].shape == (4, 64) and b["input"].dtype == np.int32
+    # y is x shifted by one: find each row's crop in the source
+    for r in range(4):
+        x, y = b["input"][r], b["target"][r]
+        np.testing.assert_array_equal(x[1:], y[:-1])
+        # and the pair actually exists in the file
+        starts = np.flatnonzero(toks[: -65].astype(np.int32) == x[0])
+        assert any(np.array_equal(toks[s : s + 64].astype(np.int32), x) for s in starts)
+    dl.close()
+
+
+def test_deterministic_and_rank_disjoint(token_file):
+    path, _ = token_file
+    a = TokenDataLoader(path, batch=2, seq_len=32, seed=5)
+    b = TokenDataLoader(path, batch=2, seq_len=32, seed=5)
+    xa, xb = a.next()["input"], b.next()["input"]
+    np.testing.assert_array_equal(xa, xb)  # same seed+rank => same stream
+    c = TokenDataLoader(path, batch=2, seq_len=32, seed=5, dp_rank=1, dp_world=2)
+    xc = c.next()["input"]
+    assert not np.array_equal(xa, xc)  # different rank => different stream
+    for dl in (a, b, c):
+        dl.close()
+
+
+def test_prefetch_many_batches(token_file):
+    path, _ = token_file
+    dl = TokenDataLoader(path, batch=8, seq_len=128, seed=1, num_prefetch_threads=3)
+    seen = set()
+    for i, batch in zip(range(50), dl):
+        seen.add(int(batch["input"][0, 0]))
+    assert len(seen) > 5  # streams vary
+    dl.close()
+
+
+def test_too_small_file_errors(tmp_path):
+    p = tmp_path / "tiny.bin"
+    np.arange(10, dtype=np.uint16).tofile(p)
+    with pytest.raises(OSError):
+        TokenDataLoader(str(p), batch=1, seq_len=64)
+
+
+def test_multi_thread_order_deterministic(token_file):
+    """regression: prefetch threads must serve batches in index order."""
+    path, _ = token_file
+    a = TokenDataLoader(path, batch=2, seq_len=32, seed=9, num_prefetch_threads=4)
+    b = TokenDataLoader(path, batch=2, seq_len=32, seed=9, num_prefetch_threads=1)
+    for _ in range(20):
+        np.testing.assert_array_equal(a.next()["input"], b.next()["input"])
+    a.close()
+    b.close()

@@ -1,0 +1,1 @@
+from .loader import TokenDataLoader, build_native

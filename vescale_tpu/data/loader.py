@@ -1,0 +1,125 @@
+"""TokenDataLoader — ctypes binding of the native prefetching loader.
+
+The TPU-native equivalent of the reference examples' data pipelines
+(legacy/examples/nanogpt_4D_finetune/finetune_4D.py get_batch): a C++
+mmap + prefetch-thread loader (data/native/dataloader.cpp) keeps the host
+input path off the TPU step's critical path.  DP sharding: each dp rank
+draws a disjoint deterministic stream, so batches differ across dp while
+runs reproduce exactly (seed-stable SplitMix64).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["TokenDataLoader", "build_native"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SRC = os.path.join(_NATIVE_DIR, "dataloader.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libvdl.so")
+_BUILD_LOCK = threading.Lock()
+_LIB = None
+
+
+def build_native(force: bool = False) -> str:
+    """Compile the native loader (g++ -O3 -shared) if needed; returns the
+    .so path."""
+    with _BUILD_LOCK:
+        if force or not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC, "-o", _SO]
+            subprocess.run(cmd, check=True, capture_output=True)
+    return _SO
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        so = build_native()
+        lib = ctypes.CDLL(so)
+        lib.vdl_open.restype = ctypes.c_void_p
+        lib.vdl_open.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int,
+        ]
+        lib.vdl_next.restype = ctypes.c_int
+        lib.vdl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.vdl_num_tokens.restype = ctypes.c_int64
+        lib.vdl_num_tokens.argtypes = [ctypes.c_void_p]
+        lib.vdl_close.restype = None
+        lib.vdl_close.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+    return _LIB
+
+
+class TokenDataLoader:
+    """Batches of (input, target) next-token pairs from a binary token file
+    (uint16 or int32/uint32 tokens, nanoGPT .bin convention).
+
+        loader = TokenDataLoader("train.bin", batch=8, seq_len=1024, seed=1)
+        batch = loader.next()   # {"input": (B,T) int32, "target": (B,T)}
+    """
+
+    def __init__(
+        self,
+        path: str,
+        batch: int,
+        seq_len: int,
+        *,
+        seed: int = 0,
+        dp_rank: int = 0,
+        dp_world: int = 1,
+        token_dtype=np.uint16,
+        num_prefetch_threads: int = 2,
+    ):
+        token_bytes = np.dtype(token_dtype).itemsize
+        if token_bytes not in (2, 4):
+            raise ValueError("token dtype must be 2 or 4 bytes")
+        self.batch, self.seq_len = batch, seq_len
+        self._h = _lib().vdl_open(
+            path.encode(), token_bytes, seq_len, batch, seed, dp_rank, dp_world, num_prefetch_threads
+        )
+        if not self._h:
+            raise OSError(f"cannot open token file {path!r} (too small or unreadable)")
+
+    @property
+    def num_tokens(self) -> int:
+        return int(_lib().vdl_num_tokens(self._h))
+
+    def next(self) -> dict:
+        x = np.empty((self.batch, self.seq_len), np.int32)
+        y = np.empty((self.batch, self.seq_len), np.int32)
+        rc = _lib().vdl_next(
+            self._h,
+            x.ctypes.data_as(ctypes.c_void_p),
+            y.ctypes.data_as(ctypes.c_void_p),
+        )
+        if rc != 0:
+            raise RuntimeError("native loader failed")
+        return {"input": x, "target": y}
+
+    def __iter__(self):
+        while True:
+            yield self.next()
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            _lib().vdl_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -44,7 +44,11 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
-    use_flash_attention: bool = True  # pallas fused kernel on TPU
+    # pallas fused kernel — single-chip jit programs only (the kernel is not
+    # GSPMD-partitionable; multi-chip attention goes through the ulysses/ring
+    # shard_map paths, or enable explicitly when attention inputs are
+    # unsharded on the attention dims)
+    use_flash_attention: bool = False
     remat: bool = False  # jax.checkpoint each block (HBM for FLOPs)
     dtype: Any = jnp.bfloat16
 

@@ -192,13 +192,10 @@ def _ulysses_fn(mesh: DeviceMesh, sp_dim: str, causal: bool, scale: float, attn_
 
 
 def _dense_attention(q, k, v, causal: bool, scale: float):
-    T = q.shape[1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    """Dense reference (single source of truth lives in ops.flash_attention)."""
+    from ..ops.flash_attention import _dense_ref
+
+    return _dense_ref(q, k, v, scale, causal)
 
 
 def blockwise_attention(q, k, v, causal: bool = True, scale: Optional[float] = None, block_size: int = 512):
@@ -235,8 +232,10 @@ def blockwise_attention(q, k, v, causal: bool = True, scale: Optional[float] = N
             mask = valid if mask is None else (mask & valid)
             return _online_block(q_blk, k_blk, v_blk, mask, scale, m, l, o)
 
-        upper = jnp.minimum(qi + 1, nb) if causal else nb
-        m, l, o = jax.lax.fori_loop(0, upper, kv_step, (m0, l0, o0))
+        # always loop all kv blocks: blocks past the causal diagonal are
+        # fully masked (zero contribution), and a STATIC bound keeps the
+        # loop reverse-mode differentiable (dynamic fori bounds are not)
+        m, l, o = jax.lax.fori_loop(0, nb, kv_step, (m0, l0, o0))
         l = jnp.where(l == 0.0, 1.0, l)
         return None, jnp.transpose((o / l[..., None]).astype(q.dtype), (0, 2, 1, 3))
 
