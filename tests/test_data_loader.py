@@ -77,3 +77,21 @@ def test_multi_thread_order_deterministic(token_file):
         np.testing.assert_array_equal(a.next()["input"], b.next()["input"])
     a.close()
     b.close()
+
+
+def test_rank_partitions_disjoint(token_file):
+    """regression: dp ranks sample from disjoint file partitions."""
+    path, toks = token_file
+    span = (100_000 - 33) // 2
+    a = TokenDataLoader(path, batch=4, seq_len=32, seed=3, dp_rank=0, dp_world=2)
+    b = TokenDataLoader(path, batch=4, seq_len=32, seed=3, dp_rank=1, dp_world=2)
+    # locate each row's crop start; rank partitions must not overlap
+    for _ in range(5):
+        for dl, lo, hi in ((a, 0, span), (b, span, 2 * span)):
+            x = dl.next()["input"]
+            for r in range(4):
+                starts = np.flatnonzero(toks[:-33].astype(np.int32) == x[r, 0])
+                hits = [s for s in starts if np.array_equal(toks[s : s + 32].astype(np.int32), x[r])]
+                assert any(lo <= s < hi + 1 for s in hits), (lo, hi, hits)
+    a.close()
+    b.close()

@@ -86,12 +86,19 @@ struct Loader {
   void fill(Batch& b, uint64_t index) {
     b.x.resize(batch * seq_len);
     b.y.resize(batch * seq_len);
-    // stream id: disjoint per (seed, rank, batch index)
+    // deterministic per (seed, rank, batch index); ranks draw from DISJOINT
+    // start-offset partitions of the file so dp shards never overlap
+    size_t full_span = num_tokens - (size_t)seq_len - 1;
+    size_t rank_span = full_span / (size_t)world;
+    size_t rank_base = (size_t)rank * rank_span;
+    if (rank_span == 0) {  // degenerate tiny file: fall back to shared span
+      rank_span = full_span;
+      rank_base = 0;
+    }
     for (int64_t row = 0; row < batch; ++row) {
       SplitMix64 rng(seed * 0x9E3779B97F4A7C15ull + (uint64_t)rank * 0x85EBCA77C2B2AE63ull +
                      index * 1000003ull + (uint64_t)row);
-      size_t span = num_tokens - (size_t)seq_len - 1;
-      size_t start = (size_t)(rng.next() % span);
+      size_t start = rank_base + (size_t)(rng.next() % rank_span);
       for (int64_t t = 0; t < seq_len; ++t) {
         b.x[row * seq_len + t] = token_at(start + t);
         b.y[row * seq_len + t] = token_at(start + t + 1);
@@ -190,7 +197,12 @@ int vdl_next(void* handle, int32_t* x_out, int32_t* y_out) {
 void vdl_close(void* handle) {
   if (!handle) return;
   auto* L = (Loader*)handle;
-  L->stop.store(true);
+  {
+    // hold the mutex while flipping stop: a worker between predicate check
+    // and blocking would otherwise miss the wakeup and hang join() forever
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stop.store(true);
+  }
   L->cv_space.notify_all();
   L->cv_ready.notify_all();
   for (auto& t : L->workers)

@@ -28,7 +28,7 @@ try:  # pallas is TPU-only at runtime; import lazily-safe
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_sharded"]
 
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/where VPU-safe
 
@@ -263,8 +263,8 @@ def flash_attention(
     v,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
 ):
     """Fused attention over (B, T, H, D) q/k/v.  GQA callers repeat K/V
@@ -277,8 +277,82 @@ def flash_attention(
         interpret = False  # off-TPU default = dense fallback, NOT interpreter
     if not _HAS_PALLAS or (not on_tpu and not interpret):
         return _dense_ref(q, k, v, scale, causal)
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
+
+    def fit(block: int) -> int:
+        # largest power-of-two block <= requested that divides T, so e.g.
+        # T=768 stays on the flash path with 256-blocks instead of silently
+        # falling back to dense O(T^2)
+        b = min(block, T)
+        while b > 8 and T % b:
+            b //= 2
+        return b
+
+    block_q, block_k = fit(block_q), fit(block_k)
     if T % block_q or T % block_k:
         return _dense_ref(q, k, v, scale, causal)
     return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+def flash_attention_sharded(
+    q,
+    k,
+    v,
+    mesh,
+    *,
+    batch_dims=("dp",),
+    head_dim: Optional[str] = "tp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+):
+    """Multi-chip flash attention: batch and/or head dims sharded over the
+    mesh.  Attention is independent per (batch, head), so the kernel runs on
+    local shards inside a shard_map with ZERO communication — this is the
+    partitioning rule GSPMD cannot derive for a pallas custom call.
+
+    ``q/k/v``: (B, T, H, D) with B shardable over ``batch_dims`` and H over
+    ``head_dim``.  Seq-sharded inputs belong to ring/ulysses instead
+    (parallel/context.py)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..collectives import shard_map
+
+    names = tuple(d for d in batch_dims if d in mesh.mesh_dim_names)
+    hd = head_dim if head_dim in mesh.mesh_dim_names else None
+    if not names and hd is None:
+        return flash_attention(q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k, interpret=interpret)
+    D = q.shape[-1]
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(D)
+    fn = _sharded_flash_fn(mesh, names, hd, causal, float(scale_), block_q, block_k, bool(interpret) if interpret is not None else None)
+    return fn(q, k, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_flash_fn(mesh, batch_names, head_name, causal, scale, block_q, block_k, interpret):
+    """Cached compiled program (jit cache is keyed on fn identity; a fresh
+    closure per call would recompile every step)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..collectives import shard_map
+
+    manual = frozenset(batch_names + ((head_name,) if head_name else ()))
+    bspec = tuple(batch_names) if len(batch_names) > 1 else (batch_names[0] if batch_names else None)
+    spec = P(bspec, None, head_name, None)
+
+    def body(q_l, k_l, v_l):
+        return flash_attention(
+            q_l, k_l, v_l, causal=causal, scale=scale, block_q=block_q, block_k=block_k, interpret=interpret
+        )
+
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh.jax_mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+            axis_names=manual,
+        )
+    )
