@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Test runner (reference scripts/run_test.sh parity): pytest per file for
+# leaked-state hygiene, CPU-forced virtual 8-device mesh.
+set -u
+cd "$(dirname "$0")/.."
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+failed=0
+for f in tests/test_*.py; do
+  echo "=== $f"
+  python -m pytest "$f" -q || failed=1
+done
+exit $failed

@@ -245,3 +245,37 @@ def test_sharded_label_smoothing_matches_dense(mesh1d):
         logits, targets, mesh=mesh1d, vocab_dim_name="tp", label_smoothing=0.1
     )
     np.testing.assert_allclose(float(dense), float(sharded), rtol=1e-6)
+
+
+def test_emulator_tuning():
+    from vescale_tpu.emulator.tuning import (
+        IciParams,
+        calculate_chunk_size,
+        choose_algorithm,
+        estimate_time_us,
+    )
+
+    # tiny message -> tree (latency bound); huge -> ring (bandwidth bound)
+    assert choose_algorithm(1024, 64) == "tree"
+    assert choose_algorithm(1 << 30, 64) == "ring"
+    c = calculate_chunk_size(10_000_000, 8)
+    assert c % 128 == 0 and c >= IciParams().min_chunk_bytes
+    assert estimate_time_us(1 << 20, 8, "ring") > 0
+
+
+def test_ndtimeline_parser(tmp_path):
+    from vescale_tpu.ndtimeline import LocalRawHandler, flush, init_ndtimers, ndtimeit
+    from vescale_tpu.ndtimeline.parser_handler import aggregate, parse_raw_spans
+
+    raw = str(tmp_path / "spans.jsonl")
+    init_ndtimers(handlers=[LocalRawHandler(raw)])
+    for _ in range(3):
+        with ndtimeit("fwd"):
+            pass
+    with ndtimeit("bwd"):
+        pass
+    flush()
+    spans = parse_raw_spans(raw)
+    assert len(spans) == 4
+    agg = aggregate(spans)
+    assert agg["fwd"]["count"] == 3 and "p99_ms" in agg["bwd"]

@@ -1,3 +1,4 @@
 from .core import Emulator, EmulatorProcessGroup, init_process_group
 from .verify import verify_all_reduce_against_xla
+from .tuning import IciParams, choose_algorithm, calculate_chunk_size, estimate_time_us
 from . import mesh_collectives

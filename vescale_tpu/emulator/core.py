@@ -114,12 +114,21 @@ class EmulatorProcessGroup:
     buffers and executes emulated collectives in place."""
 
     def __init__(self, world_size: int, algo: str = "ring"):
+        if algo not in ("ring", "tree", "auto"):
+            raise ValueError(f"unknown algorithm {algo!r}")
         self.world_size = world_size
         self.algo = algo
         self.emulator = Emulator(world_size)
 
+    def _pick(self, tensors) -> str:
+        if self.algo != "auto":
+            return self.algo
+        from .tuning import choose_algorithm
+
+        return choose_algorithm(int(tensors[0].nbytes), self.world_size)
+
     def all_reduce(self, tensors: List[np.ndarray], op: str = "sum") -> List[np.ndarray]:
-        if self.algo == "tree":
+        if self._pick(tensors) == "tree":
             return self.emulator.tree_all_reduce(tensors, op)
         return self.emulator.ring_all_reduce(tensors, op)
 

@@ -1,0 +1,65 @@
+"""Collective algorithm selection + chunk-size modeling.
+
+Capability parity with the reference's NCCL tuning layer
+(legacy/vescale/emulator/calculate_chunk_size.py + nccl/graph/tuning.py +
+nccl/constants.py): choose ring vs tree per message size and model the chunk
+schedule.  On TPU there is no LL/LL128 protocol split; the model reduces to
+ICI latency/bandwidth terms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Literal
+
+__all__ = ["IciParams", "choose_algorithm", "calculate_chunk_size", "estimate_time_us"]
+
+
+from ..collectives import _ICI_GBPS, _LAUNCH_US
+
+
+@dataclasses.dataclass(frozen=True)
+class IciParams:
+    """Per-link ICI characteristics (defaults shared with the auto-plan cost
+    model in collectives.py so the two layers cannot drift)."""
+
+    bandwidth_gbps: float = _ICI_GBPS
+    latency_us: float = _LAUNCH_US
+    min_chunk_bytes: int = 4096
+    max_chunk_bytes: int = 1 << 22  # 4 MiB
+
+
+def choose_algorithm(nbytes: int, world: int, params: IciParams = IciParams()) -> Literal["ring", "tree"]:
+    """Ring amortizes bandwidth for large messages; tree wins on latency for
+    small ones (the reference's tuning-table decision, reduced to the
+    crossover of the two cost models)."""
+    if world <= 2:
+        return "ring"
+    ring = estimate_time_us(nbytes, world, "ring", params)
+    tree = estimate_time_us(nbytes, world, "tree", params)
+    return "ring" if ring <= tree else "tree"
+
+
+def estimate_time_us(nbytes: int, world: int, algo: str, params: IciParams = IciParams()) -> float:
+    if algo not in ("ring", "tree"):
+        raise ValueError(f"unknown algorithm {algo!r}; expected 'ring' or 'tree'")
+    gb = nbytes / 1e9
+    bw_us = gb / params.bandwidth_gbps * 1e6
+    if algo == "ring":
+        # 2(n-1)/n bandwidth term, 2(n-1) latency hops (reduce-scatter + ag)
+        return 2 * (world - 1) * params.latency_us + 2 * (world - 1) / world * bw_us
+    # tree: log2(n) latency depth (up + down), but the full message crosses
+    # each tree level -> ~2x bandwidth term; latency-optimal, bw-suboptimal
+    import math
+
+    depth = math.ceil(math.log2(max(2, world)))
+    return 2 * depth * params.latency_us + 2.0 * bw_us
+
+
+def calculate_chunk_size(nbytes: int, world: int, params: IciParams = IciParams()) -> int:
+    """Ring chunk size (reference calculate_chunk_size.py): message split in
+    `world` chunks, clamped to [min_chunk, max_chunk], 128-byte aligned."""
+    if world <= 0:
+        raise ValueError("world must be positive")
+    chunk = max(params.min_chunk_bytes, min(params.max_chunk_bytes, -(-nbytes // world)))
+    return (chunk + 127) // 128 * 128
