@@ -123,3 +123,42 @@ def test_dropout_bitwise_deterministic(mesh2d):
     out_sharded = dm.apply(variables, x, deterministic=False, rngs={"dropout": key})
     out_single = model.apply(variables, x, deterministic=False, rngs={"dropout": key})
     np.testing.assert_allclose(np.asarray(out_sharded), np.asarray(out_single), rtol=2e-5, atol=2e-5)
+
+
+def test_grad_accumulation_matches_full_batch(mesh2d):
+    """k micro-batches accumulated == one full batch (linear loss mean)."""
+    model = GPT(CFG)
+    dm = parallelize_module(model, mesh2d, nanogpt_plan(mesh2d))
+    variables = dm.init(jax.random.key(0), jnp.ones((2, 8), jnp.int32))
+    params = variables["params"]
+    tx = optax.sgd(1e-2)
+    opt = tx.init(params)
+    batch = _batch(jax.random.key(3), bsz=8)
+
+    step_full = make_train_step(dm, tx, _loss, donate=False)
+    step_accum = make_train_step(dm, tx, _loss, donate=False, grad_accum_steps=4)
+    p1, _, l1 = step_full(params, opt, batch)
+    p2, _, l2 = step_accum(params, opt, batch)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+
+
+def test_vedevicemesh_nanogpt_e2e():
+    """nanoGPT through the global VeDeviceMesh singleton (reference
+    legacy/test/parallel/devicemesh_api/test_nano_gpt.py)."""
+    from vescale_tpu.devicemesh_api import VeDeviceMesh
+
+    vdm = VeDeviceMesh()
+    up = vdm.init_device_mesh("cpu", (2, 4), mesh_dim_names=("DP", "TP"))
+    assert vdm.get_data_parallel_rank() == 0 and vdm.is_last_stage()
+    # the rank helpers are case-insensitive; plans address dims by exact
+    # name, so build the training mesh with the plan's lowercase names
+    mesh = vdm.init_device_mesh("cpu", (2, 4), mesh_dim_names=("dp", "tp"))
+    model = GPT(CFG)
+    dm = parallelize_module(model, mesh, nanogpt_plan(mesh))
+    v = dm.init(jax.random.key(0), jnp.ones((2, 8), jnp.int32))
+    k = v["params"]["h_0"]["attn"]["c_attn"]["kernel"]
+    assert "tp" in str(k.sharding.spec)
+    out = dm.apply(v, jnp.ones((2, 8), jnp.int32))
+    assert out.shape == (2, 8, CFG.vocab_size)

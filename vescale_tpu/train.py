@@ -29,6 +29,7 @@ def make_train_step(
     has_aux: bool = False,
     donate: bool = True,
     rng_streams: tuple = ("dropout",),
+    grad_accum_steps: int = 1,
 ):
     """Build ``train_step(params, opt_state, batch, step_key) ->
     (params, opt_state, loss)``.
@@ -36,25 +37,64 @@ def make_train_step(
     ``loss_fn(logits_or_outputs, batch)`` computes the scalar loss from the
     model output.  Dropout etc. draw from ``step_key`` folded per stream —
     deterministic and bitwise-identical under any sharding.
+
+    ``grad_accum_steps`` > 1 splits the batch into micro-batches accumulated
+    in fp32 via ``lax.scan`` (the reference DDP's main_grad accumulation,
+    ddp/grad_buffer.py, expressed functionally) before one optimizer update.
+    The accumulated grads/loss are averaged over micro-batches, so
+    ``loss_fn`` must be MEAN-reduced for step-1 equivalence (a sum-reduced
+    loss would be scaled by 1/grad_accum_steps).
     """
+    if has_aux and grad_accum_steps > 1:
+        raise NotImplementedError("has_aux with grad accumulation")
+
+    def micro_loss(p, micro_batch, step_key):
+        rngs = (
+            {name: jax.random.fold_in(step_key, i) for i, name in enumerate(rng_streams)}
+            if step_key is not None
+            else None
+        )
+        out = dmodel.apply(
+            {"params": p}, micro_batch["input"], deterministic=step_key is None, rngs=rngs
+        )
+        return loss_fn(out, micro_batch)
 
     def step(params, opt_state, batch, step_key=None):
-        def compute_loss(p):
-            rngs = (
-                {name: jax.random.fold_in(step_key, i) for i, name in enumerate(rng_streams)}
-                if step_key is not None
-                else None
-            )
-            deterministic = step_key is None
-            out = dmodel.apply(
-                {"params": p}, batch["input"], deterministic=deterministic, rngs=rngs
-            )
-            return loss_fn(out, batch)
-
-        if has_aux:
-            (loss, aux), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+        if grad_accum_steps <= 1:
+            if has_aux:
+                (loss, aux), grads = jax.value_and_grad(
+                    lambda p: micro_loss(p, batch, step_key), has_aux=True
+                )(params)
+            else:
+                loss, grads = jax.value_and_grad(lambda p: micro_loss(p, batch, step_key))(params)
+                aux = None
         else:
-            loss, grads = jax.value_and_grad(compute_loss)(params)
+            b0 = jax.tree_util.tree_leaves(batch)[0].shape[0]
+            if b0 % grad_accum_steps != 0:
+                raise ValueError(
+                    f"batch dim {b0} not divisible by grad_accum_steps={grad_accum_steps}"
+                )
+            micros = jax.tree_util.tree_map(
+                lambda x: x.reshape(grad_accum_steps, x.shape[0] // grad_accum_steps, *x.shape[1:]),
+                batch,
+            )
+
+            def accum(carry, inputs):
+                g_acc, l_acc = carry
+                mb, i = inputs
+                key_i = jax.random.fold_in(step_key, 1000 + i) if step_key is not None else None
+                l, g = jax.value_and_grad(lambda p: micro_loss(p, mb, key_i))(params)
+                g_acc = jax.tree_util.tree_map(lambda a, b: a + b.astype(a.dtype), g_acc, g)
+                return (g_acc, l_acc + l), None
+
+            g0 = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (g_sum, l_sum), _ = jax.lax.scan(
+                accum, (g0, 0.0), (micros, jnp.arange(grad_accum_steps))
+            )
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / grad_accum_steps).astype(p.dtype), g_sum, params
+            )
+            loss = l_sum / grad_accum_steps
             aux = None
         updates, new_opt_state = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
