@@ -1,0 +1,132 @@
+"""HF/torch checkpoint interop for the llama/mixtral families.
+
+The reference finetunes HuggingFace checkpoints directly
+(legacy/examples/open_llama_4D_benchmark/download_open_llama_ckpt.py,
+llama2_4D_finetune).  TPU-native equivalent: map a torch/HF llama state
+dict onto the vescale_tpu flax param tree (kernels transposed, per-layer
+FQN rewrite), then shard via the DModule plan — the load-time reshard
+happens for free when the params are device_put with their NamedShardings.
+
+Works from an in-memory torch state dict (torch CPU is available) or a
+directory of ``.safetensors``/``pytorch_model*.bin`` shards.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from .llama import LlamaConfig
+
+__all__ = ["hf_llama_to_params", "load_hf_llama"]
+
+
+def _to_np(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    try:  # torch tensor
+        return t.detach().cpu().float().numpy()
+    except AttributeError:
+        return np.asarray(t)
+
+
+def hf_llama_to_params(state_dict: Mapping[str, Any], config: LlamaConfig) -> Dict[str, Any]:
+    """Map an HF ``LlamaForCausalLM`` state dict to the flax params tree of
+    models/llama.Llama.
+
+    Name map (HF -> ours):
+      model.embed_tokens.weight            -> embed_tokens.embedding
+      model.layers.N.self_attn.{q,k,v,o}_proj.weight -> layers_N.self_attn.*.kernel (transposed)
+      model.layers.N.mlp.{gate,up,down}_proj.weight  -> layers_N.mlp.*.kernel (transposed)
+      model.layers.N.input_layernorm.weight          -> layers_N.input_layernorm.weight
+      model.layers.N.post_attention_layernorm.weight -> layers_N.post_attention_layernorm.weight
+      model.norm.weight                    -> norm.weight
+      lm_head.weight                       -> lm_head.kernel (transposed)
+    """
+    # params stay fp32 (flax param_dtype convention — the model's `dtype`
+    # casts per-layer compute to bf16); bf16 master params would silently
+    # degrade AdamW finetuning
+    params: Dict[str, Any] = {}
+
+    def put(path: str, arr: np.ndarray, transpose: bool = False, cast=True):
+        if transpose:
+            arr = arr.T
+        node = params
+        parts = path.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(arr, dtype=jnp.float32)
+
+    consumed = set()
+    for name, tensor in state_dict.items():
+        arr = _to_np(tensor)
+        m = re.fullmatch(r"model\.layers\.(\d+)\.(.+)", name)
+        if m:
+            i, rest = int(m.group(1)), m.group(2)
+            base = f"layers_{i}"
+            if rest.endswith("_proj.weight"):
+                sub = rest[: -len(".weight")]  # e.g. self_attn.q_proj
+                put(f"{base}.{sub}.kernel", arr, transpose=True)
+            elif rest in ("input_layernorm.weight", "post_attention_layernorm.weight"):
+                put(f"{base}.{rest}", arr, cast=False)  # RMSNorm scales fp32
+            else:
+                continue
+            consumed.add(name)
+        elif name == "model.embed_tokens.weight":
+            put("embed_tokens.embedding", arr)
+            consumed.add(name)
+        elif name == "model.norm.weight":
+            put("norm.weight", arr, cast=False)
+            consumed.add(name)
+        elif name == "lm_head.weight":
+            if not config.tie_word_embeddings:
+                put("lm_head.kernel", arr, transpose=True)
+            consumed.add(name)
+
+    missing = []
+    for i in range(config.num_hidden_layers):
+        for sub in ("self_attn.q_proj", "self_attn.k_proj", "self_attn.v_proj", "self_attn.o_proj",
+                    "mlp.gate_proj", "mlp.up_proj", "mlp.down_proj"):
+            if f"model.layers.{i}.{sub}.weight" not in consumed:
+                missing.append(f"model.layers.{i}.{sub}.weight")
+        for ln in ("input_layernorm", "post_attention_layernorm"):
+            if f"model.layers.{i}.{ln}.weight" not in consumed:
+                missing.append(f"model.layers.{i}.{ln}.weight")
+    if "model.embed_tokens.weight" not in consumed:
+        missing.append("model.embed_tokens.weight")
+    if "model.norm.weight" not in consumed:
+        missing.append("model.norm.weight")
+    if not config.tie_word_embeddings and "lm_head.weight" not in consumed:
+        missing.append("lm_head.weight (or set tie_word_embeddings=True)")
+    if missing:
+        raise ValueError(f"HF state dict is missing {len(missing)} tensors, e.g. {missing[:4]}")
+    return params
+
+
+def load_hf_llama(path: str, config: LlamaConfig) -> Dict[str, Any]:
+    """Load from a checkpoint directory: all ``*.safetensors`` or
+    ``pytorch_model*.bin`` shards under ``path`` are merged."""
+    state: Dict[str, Any] = {}
+    st_files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    bin_files = sorted(
+        f for f in os.listdir(path) if f.startswith("pytorch_model") and f.endswith(".bin")
+    )
+    if st_files:
+        from safetensors import safe_open  # available via transformers' deps
+
+        for f in st_files:
+            with safe_open(os.path.join(path, f), framework="np") as sf:
+                for k in sf.keys():
+                    state[k] = sf.get_tensor(k)
+    elif bin_files:
+        import torch
+
+        for f in bin_files:
+            state.update(torch.load(os.path.join(path, f), map_location="cpu", weights_only=True))
+    else:
+        raise FileNotFoundError(f"no .safetensors or pytorch_model*.bin under {path}")
+    return hf_llama_to_params(state, config)
