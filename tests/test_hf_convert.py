@@ -110,3 +110,25 @@ def test_hf_conversion_missing_tensor_errors():
     del sd["model.layers.1.mlp.down_proj.weight"]
     with pytest.raises(ValueError):
         hf_llama_to_params(sd, CFG)
+
+
+def test_load_hf_llama_from_sharded_bins(tmp_path):
+    """directory loader: merged pytorch_model*.bin shards == in-memory path."""
+    from vescale_tpu.models.convert import load_hf_llama
+
+    sd = _fake_hf_state(CFG)
+    keys = sorted(sd)
+    half = len(keys) // 2
+    torch.save({k: sd[k] for k in keys[:half]}, tmp_path / "pytorch_model-00001.bin")
+    torch.save({k: sd[k] for k in keys[half:]}, tmp_path / "pytorch_model-00002.bin")
+    loaded = load_hf_llama(str(tmp_path), CFG)
+    direct = hf_llama_to_params(sd, CFG)
+    for a, b in zip(jax.tree_util.tree_leaves(loaded), jax.tree_util.tree_leaves(direct)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_surplus_layers_rejected():
+    sd = _fake_hf_state(CFG)
+    sd["model.layers.5.mlp.down_proj.weight"] = torch.zeros(32, 48)
+    with pytest.raises(ValueError):
+        hf_llama_to_params(sd, CFG)

@@ -109,3 +109,31 @@ def test_strided_ragged_layout():
     np.testing.assert_array_equal(np.asarray(spec.unpack(phys)), np.asarray(x))
     # ep rank 2 owns ragged chunk [6:12); fsdp rank 1 owns its 2nd half
     assert spec.ragged_local_chunk((1, 2)) == (3, 9)
+
+
+def test_spec_hash_equality(mesh2d):
+    """DTensorSpec hash/eq semantics (reference legacy/test/dtensor/hash)."""
+    a = DArraySpec(mesh2d, [Shard(0), Replicate()], TensorMeta((8, 4), jnp.float32))
+    b = DArraySpec(mesh2d, [Shard(0), Replicate()], TensorMeta((8, 4), jnp.float32))
+    c = DArraySpec(mesh2d, [Shard(1), Replicate()], TensorMeta((8, 4), jnp.float32))
+    assert a == b and hash(a) == hash(b)
+    assert a != c
+    assert len({a, b, c}) == 2
+    # usable as cache keys (the reference's lru-cached sharding prop)
+    cache = {a: 1}
+    assert cache[b] == 1
+
+
+def test_meta_device_style_flow(mesh2d):
+    """Shape-only mesh/spec logic with zero allocation (reference
+    meta-device DeviceMesh tests, dtensor/README.md:90)."""
+    import jax
+
+    aval = jax.ShapeDtypeStruct((16, 8), jnp.float32)
+    spec = DArraySpec(mesh2d, [Shard(0), Shard(1)], TensorMeta(aval.shape, aval.dtype))
+    assert spec.layout().physical_shape == (16, 8)
+    shape, offs = spec.local_chunk((1, 3))
+    assert shape == (8, 2) and offs == (8, 6)
+    # named sharding derivable without any data
+    ns = spec.named_sharding()
+    assert ns.shard_shape((16, 8)) == (8, 2)

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import os
 import re
-from typing import Any, Dict, Mapping, Optional
+from typing import Any, Dict, Mapping
 
 import numpy as np
 import jax.numpy as jnp
@@ -52,7 +52,7 @@ def hf_llama_to_params(state_dict: Mapping[str, Any], config: LlamaConfig) -> Di
     # degrade AdamW finetuning
     params: Dict[str, Any] = {}
 
-    def put(path: str, arr: np.ndarray, transpose: bool = False, cast=True):
+    def put(path: str, arr: np.ndarray, transpose: bool = False):
         if transpose:
             arr = arr.T
         node = params
@@ -63,8 +63,13 @@ def hf_llama_to_params(state_dict: Mapping[str, Any], config: LlamaConfig) -> Di
 
     consumed = set()
     for name, tensor in state_dict.items():
-        arr = _to_np(tensor)
         m = re.fullmatch(r"model\.layers\.(\d+)\.(.+)", name)
+        if m and int(m.group(1)) >= config.num_hidden_layers:
+            raise ValueError(
+                f"{name} exceeds config.num_hidden_layers={config.num_hidden_layers}; "
+                "a truncated conversion would silently change the model"
+            )
+        arr = _to_np(tensor)
         if m:
             i, rest = int(m.group(1)), m.group(2)
             base = f"layers_{i}"
@@ -72,7 +77,7 @@ def hf_llama_to_params(state_dict: Mapping[str, Any], config: LlamaConfig) -> Di
                 sub = rest[: -len(".weight")]  # e.g. self_attn.q_proj
                 put(f"{base}.{sub}.kernel", arr, transpose=True)
             elif rest in ("input_layernorm.weight", "post_attention_layernorm.weight"):
-                put(f"{base}.{rest}", arr, cast=False)  # RMSNorm scales fp32
+                put(f"{base}.{rest}", arr)
             else:
                 continue
             consumed.add(name)
@@ -80,7 +85,7 @@ def hf_llama_to_params(state_dict: Mapping[str, Any], config: LlamaConfig) -> Di
             put("embed_tokens.embedding", arr)
             consumed.add(name)
         elif name == "model.norm.weight":
-            put("norm.weight", arr, cast=False)
+            put("norm.weight", arr)
             consumed.add(name)
         elif name == "lm_head.weight":
             if not config.tie_word_embeddings:
