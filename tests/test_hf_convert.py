@@ -132,3 +132,107 @@ def test_surplus_layers_rejected():
     sd["model.layers.5.mlp.down_proj.weight"] = torch.zeros(32, 48)
     with pytest.raises(ValueError):
         hf_llama_to_params(sd, CFG)
+
+
+def test_hf_mixtral_conversion_logits_match():
+    """HF Mixtral (SwiGLU experts) maps onto our model; logits match a torch
+    reference of the same single MoE layer computation."""
+    from vescale_tpu.models.convert import hf_mixtral_to_params
+    from vescale_tpu.models.mixtral import Mixtral, MixtralConfig
+
+    mcfg = MixtralConfig(
+        vocab_size=64,
+        hidden_size=32,
+        intermediate_size=48,
+        num_hidden_layers=1,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        capacity_factor=8.0,  # no drops: exact match vs dense torch routing
+        max_position_embeddings=32,
+        dtype=jnp.float32,
+    )
+    g = torch.Generator().manual_seed(1)
+
+    def W(o, i):
+        return torch.randn(o, i, generator=g) * 0.05
+
+    d, it, E = 32, 48, 4
+    sd = {
+        "model.embed_tokens.weight": W(64, d),
+        "model.norm.weight": torch.ones(d),
+        "lm_head.weight": W(64, d),
+    }
+    p = "model.layers.0."
+    hd = mcfg.as_llama().head_dim
+    sd[p + "self_attn.q_proj.weight"] = W(4 * hd, d)
+    sd[p + "self_attn.k_proj.weight"] = W(2 * hd, d)
+    sd[p + "self_attn.v_proj.weight"] = W(2 * hd, d)
+    sd[p + "self_attn.o_proj.weight"] = W(d, 4 * hd)
+    sd[p + "input_layernorm.weight"] = torch.ones(d)
+    sd[p + "post_attention_layernorm.weight"] = torch.ones(d)
+    sd[p + "block_sparse_moe.gate.weight"] = W(E, d)
+    for k in range(E):
+        sd[p + f"block_sparse_moe.experts.{k}.w1.weight"] = W(it, d)
+        sd[p + f"block_sparse_moe.experts.{k}.w2.weight"] = W(d, it)
+        sd[p + f"block_sparse_moe.experts.{k}.w3.weight"] = W(it, d)
+
+    params = hf_mixtral_to_params(sd, mcfg)
+    idx = np.array([[3, 9, 1, 40, 22, 5, 60, 11]])
+    ours, _ = Mixtral(mcfg).apply({"params": params}, jnp.asarray(idx), mutable=["losses"])
+
+    # torch reference: hand-rolled attention + dense top-2 SwiGLU routing
+    x = sd["model.embed_tokens.weight"][torch.tensor(idx)]
+
+    def rms(x, w, eps=1e-5):
+        v = x * torch.rsqrt((x.float() ** 2).mean(-1, keepdim=True) + eps)
+        return v * w
+
+    B, T, _ = x.shape
+
+    def rotary(q, k):
+        freqs = 1.0 / (mcfg.rope_theta ** (torch.arange(0, hd, 2).float() / hd))
+        ang = torch.arange(T).float()[:, None] * freqs
+        cos, sin = torch.cos(ang), torch.sin(ang)
+
+        def rot(t):
+            t1, t2 = t[..., : hd // 2], t[..., hd // 2 :]
+            return torch.cat(
+                [t1 * cos[None, :, None, :] - t2 * sin[None, :, None, :],
+                 t2 * cos[None, :, None, :] + t1 * sin[None, :, None, :]], dim=-1)
+
+        return rot(q), rot(k)
+
+    h = rms(x, sd[p + "input_layernorm.weight"])
+    q = (h @ sd[p + "self_attn.q_proj.weight"].T).view(B, T, 4, hd)
+    k = (h @ sd[p + "self_attn.k_proj.weight"].T).view(B, T, 2, hd)
+    v = (h @ sd[p + "self_attn.v_proj.weight"].T).view(B, T, 2, hd)
+    q, k = rotary(q, k)
+    k = k.repeat_interleave(2, dim=2)
+    v = v.repeat_interleave(2, dim=2)
+    att = torch.einsum("bqhd,bkhd->bhqk", q, k) / (hd ** 0.5)
+    mask = torch.tril(torch.ones(T, T, dtype=torch.bool))
+    att = att.masked_fill(~mask, float("-inf")).softmax(-1)
+    x = x + torch.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, -1) @ sd[p + "self_attn.o_proj.weight"].T
+
+    h = rms(x, sd[p + "post_attention_layernorm.weight"])
+    h2 = h.reshape(-1, d)
+    logits_r = h2 @ sd[p + "block_sparse_moe.gate.weight"].T
+    probs = logits_r.softmax(-1)
+    vals, idxs = probs.topk(2, dim=-1)
+    vals = vals / vals.sum(-1, keepdim=True)
+    y = torch.zeros_like(h2)
+    for n in range(h2.shape[0]):
+        for j in range(2):
+            e = int(idxs[n, j])
+            w1 = sd[p + f"block_sparse_moe.experts.{e}.w1.weight"]
+            w2 = sd[p + f"block_sparse_moe.experts.{e}.w2.weight"]
+            w3 = sd[p + f"block_sparse_moe.experts.{e}.w3.weight"]
+            y[n] += vals[n, j] * (
+                (torch.nn.functional.silu(h2[n] @ w1.T) * (h2[n] @ w3.T)) @ w2.T
+            )
+    x = x + y.view(B, T, d)
+    x = rms(x, sd["model.norm.weight"])
+    golden = (x @ sd["lm_head.weight"].T).detach().numpy()
+    np.testing.assert_allclose(np.asarray(ours), golden, rtol=3e-4, atol=3e-4)

@@ -22,7 +22,27 @@ import jax.numpy as jnp
 
 from .llama import LlamaConfig
 
-__all__ = ["hf_llama_to_params", "load_hf_llama"]
+__all__ = ["hf_llama_to_params", "load_hf_llama", "hf_mixtral_to_params"]
+
+
+def _put(params: Dict[str, Any], path: str, arr: np.ndarray, transpose: bool = False) -> None:
+    """Insert into a nested dict at a dotted path; params stay fp32 (flax
+    param_dtype convention — the model's `dtype` handles compute casting)."""
+    if transpose:
+        arr = arr.T
+    node = params
+    parts = path.split(".")
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = jnp.asarray(arr, dtype=jnp.float32)
+
+
+def _check_layer_bound(name: str, m, num_layers: int) -> None:
+    if m and int(m.group(1)) >= num_layers:
+        raise ValueError(
+            f"{name} exceeds config.num_hidden_layers={num_layers}; "
+            "a truncated conversion would silently change the model"
+        )
 
 
 def _to_np(t) -> np.ndarray:
@@ -47,28 +67,15 @@ def hf_llama_to_params(state_dict: Mapping[str, Any], config: LlamaConfig) -> Di
       model.norm.weight                    -> norm.weight
       lm_head.weight                       -> lm_head.kernel (transposed)
     """
-    # params stay fp32 (flax param_dtype convention — the model's `dtype`
-    # casts per-layer compute to bf16); bf16 master params would silently
-    # degrade AdamW finetuning
     params: Dict[str, Any] = {}
 
-    def put(path: str, arr: np.ndarray, transpose: bool = False):
-        if transpose:
-            arr = arr.T
-        node = params
-        parts = path.split(".")
-        for p in parts[:-1]:
-            node = node.setdefault(p, {})
-        node[parts[-1]] = jnp.asarray(arr, dtype=jnp.float32)
+    def put(path, arr, transpose=False):
+        _put(params, path, arr, transpose)
 
     consumed = set()
     for name, tensor in state_dict.items():
         m = re.fullmatch(r"model\.layers\.(\d+)\.(.+)", name)
-        if m and int(m.group(1)) >= config.num_hidden_layers:
-            raise ValueError(
-                f"{name} exceeds config.num_hidden_layers={config.num_hidden_layers}; "
-                "a truncated conversion would silently change the model"
-            )
+        _check_layer_bound(name, m, config.num_hidden_layers)
         arr = _to_np(tensor)
         if m:
             i, rest = int(m.group(1)), m.group(2)
@@ -135,3 +142,89 @@ def load_hf_llama(path: str, config: LlamaConfig) -> Dict[str, Any]:
     else:
         raise FileNotFoundError(f"no .safetensors or pytorch_model*.bin under {path}")
     return hf_llama_to_params(state, config)
+
+
+def hf_mixtral_to_params(state_dict: Mapping[str, Any], config) -> Dict[str, Any]:
+    """Map an HF ``MixtralForCausalLM`` state dict onto models/mixtral.Mixtral.
+
+    Expert map (HF -> ours, per layer; ours stacks experts on a leading dim):
+      block_sparse_moe.gate.weight        -> block_sparse_moe.router (transposed)
+      block_sparse_moe.experts.K.w1.weight -> block_sparse_moe.w_gate[K] (transposed)
+      block_sparse_moe.experts.K.w3.weight -> block_sparse_moe.w_in[K]   (transposed)
+      block_sparse_moe.experts.K.w2.weight -> block_sparse_moe.w_out[K]  (transposed)
+    Attention/norm/embed/head names follow the llama map.
+    """
+    params: Dict[str, Any] = {}
+
+    def put(path, arr, transpose=False):
+        _put(params, path, arr, transpose)
+
+    E = config.num_local_experts
+    expert_stacks: Dict[str, Dict[str, list]] = {}
+    consumed = set()
+    for name, tensor in state_dict.items():
+        m = re.fullmatch(r"model\.layers\.(\d+)\.(.+)", name)
+        _check_layer_bound(name, m, config.num_hidden_layers)
+        arr = _to_np(tensor)
+        if m:
+            i, rest = int(m.group(1)), m.group(2)
+            base = f"layers_{i}"
+            em = re.fullmatch(r"block_sparse_moe\.experts\.(\d+)\.(w1|w2|w3)\.weight", rest)
+            if em:
+                k, w = int(em.group(1)), em.group(2)
+                if k >= E:
+                    raise ValueError(
+                        f"{name} exceeds config.num_local_experts={E}"
+                    )
+                ours = {"w1": "w_gate", "w3": "w_in", "w2": "w_out"}[w]
+                expert_stacks.setdefault(base, {}).setdefault(ours, [None] * E)[k] = arr.T
+                consumed.add(name)
+            elif rest == "block_sparse_moe.gate.weight":
+                put(f"{base}.block_sparse_moe.router", arr, transpose=True)
+                consumed.add(name)
+            elif rest.endswith("_proj.weight"):
+                put(f"{base}.{rest[: -len('.weight')]}.kernel", arr, transpose=True)
+                consumed.add(name)
+            elif rest in ("input_layernorm.weight", "post_attention_layernorm.weight"):
+                put(f"{base}.{rest}", arr)
+                consumed.add(name)
+        elif name == "model.embed_tokens.weight":
+            put("embed_tokens.embedding", arr)
+            consumed.add(name)
+        elif name == "model.norm.weight":
+            put("norm.weight", arr)
+            consumed.add(name)
+        elif name == "lm_head.weight":
+            put("lm_head.kernel", arr, transpose=True)
+            consumed.add(name)
+
+    # completeness: every layer needs attention/norms/router + full expert
+    # stacks (mirrors the llama check; partial trees fail obscurely in flax)
+    missing = []
+    for i in range(config.num_hidden_layers):
+        pre = f"model.layers.{i}."
+        for sub in ("self_attn.q_proj", "self_attn.k_proj", "self_attn.v_proj", "self_attn.o_proj"):
+            if pre + sub + ".weight" not in consumed:
+                missing.append(pre + sub + ".weight")
+        for ln in ("input_layernorm", "post_attention_layernorm"):
+            if pre + ln + ".weight" not in consumed:
+                missing.append(pre + ln + ".weight")
+        if pre + "block_sparse_moe.gate.weight" not in consumed:
+            missing.append(pre + "block_sparse_moe.gate.weight")
+        for k in range(E):
+            for w in ("w1", "w2", "w3"):
+                if pre + f"block_sparse_moe.experts.{k}.{w}.weight" not in consumed:
+                    missing.append(pre + f"block_sparse_moe.experts.{k}.{w}.weight")
+    for g in ("model.embed_tokens.weight", "model.norm.weight", "lm_head.weight"):
+        if g not in consumed:
+            missing.append(g)
+    if missing:
+        raise ValueError(f"HF state dict is missing {len(missing)} tensors, e.g. {missing[:4]}")
+
+    for base, stacks in expert_stacks.items():
+        for ours, slots in stacks.items():
+            put(f"{base}.block_sparse_moe.{ours}", np.stack(slots, axis=0))
+        d_ff, d = stacks["w_out"][0].shape[0], stacks["w_out"][0].shape[1]
+        put(f"{base}.block_sparse_moe.b_in", np.zeros((E, d_ff), np.float32))
+        put(f"{base}.block_sparse_moe.b_out", np.zeros((E, d), np.float32))
+    return params

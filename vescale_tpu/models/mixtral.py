@@ -60,6 +60,7 @@ class MixtralConfig:
             d_ff=self.intermediate_size,
             top_k=self.num_experts_per_tok,
             capacity_factor=self.capacity_factor,
+            swiglu=True,  # HF Mixtral expert convention (w1/w3/w2)
             dtype=self.dtype,
         )
 
@@ -118,7 +119,7 @@ def mixtral_plan(mesh, ep_dim: str = "ep", sequence_parallel: bool = False):
 
     dp_only = pl(dp=S(0))
     param_plan = {
-        r".*block_sparse_moe\.(w_in|w_out|b_in|b_out)": pl(ep=S(0)),
+        r".*block_sparse_moe\.(w_in|w_out|w_gate|b_in|b_out)": pl(ep=S(0)),
         r".*block_sparse_moe\.router": [R] * mesh.ndim,
     }
     if has_tp:

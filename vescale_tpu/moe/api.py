@@ -31,7 +31,7 @@ def moe_plan(mesh: DeviceMesh, experts_expr: str = r".*moe.*", ep_dim: str = "ep
         return out
 
     return {
-        experts_expr.rstrip("$") + r"\.(w_in|w_out|b_in|b_out)": pl(0),
+        experts_expr.rstrip("$") + r"\.(w_in|w_out|w_gate|b_in|b_out)": pl(0),
         experts_expr.rstrip("$") + r"\.router": pl(None),
     }
 

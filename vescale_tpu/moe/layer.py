@@ -29,11 +29,12 @@ class MoEConfig:
     capacity_factor: float = 2.0
     router_jitter: float = 0.0
     aux_loss_coef: float = 0.01
+    swiglu: bool = False  # SwiGLU experts (HF Mixtral convention) vs GELU
     dtype: Any = jnp.float32
 
 
 class MoEMLP(nn.Module):
-    """Top-k gated expert MLP bank (SwiGLU-free GELU variant).
+    """Top-k gated expert MLP bank (GELU default; SwiGLU via config.swiglu).
 
     Returns (y, aux_loss).  Dispatch/combine are dense one-hot einsums with
     per-expert capacity C = ceil(k * N / E * capacity_factor); dropped tokens
@@ -62,6 +63,10 @@ class MoEMLP(nn.Module):
             "w_out", nn.initializers.lecun_normal(), (E, c.d_ff, d), c.dtype
         )
         b_out = self.param("b_out", nn.initializers.zeros, (E, d), c.dtype)
+        if c.swiglu:
+            w_gate = self.param(
+                "w_gate", nn.initializers.lecun_normal(), (E, d, c.d_ff), c.dtype
+            )
 
         logits = (x2.astype(jnp.float32) @ router)  # (N, E) fp32 routing
         probs = jax.nn.softmax(logits, axis=-1)
@@ -75,7 +80,13 @@ class MoEMLP(nn.Module):
         disp, comb = td.build_masks(gate_idx, gate_vals)  # (N,E,C) fp32
 
         xe = td.dispatch(x2.astype(c.dtype), disp)  # (E, C, d)
-        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, w_in) + b_in[:, None, :])
+        if c.swiglu:
+            # SwiGLU experts (HF Mixtral w1/w3/w2): silu(gate) * up -> down
+            g = nn.silu(jnp.einsum("ecd,edf->ecf", xe, w_gate))
+            u = jnp.einsum("ecd,edf->ecf", xe, w_in) + b_in[:, None, :]
+            h = g * u
+        else:
+            h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, w_in) + b_in[:, None, :])
         ye = jnp.einsum("ecf,efd->ecd", h, w_out) + b_out[:, None, :]
         y = td.combine(ye, comb)  # (N, d)
 
