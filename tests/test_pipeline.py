@@ -236,3 +236,16 @@ def test_forward_only_without_target():
     for g in range(pm.num_groups):
         x = pm.group_forward(g)(params[g], x)
     np.testing.assert_allclose(np.asarray(outs), np.asarray(x), rtol=1e-5, atol=1e-5)
+
+
+def test_dryrun_4d_real_api_stack():
+    """The driver's multichip rung: llama pp x dp x tp through
+    parallelize_module + llama_plan + compiled pipeline + ZeRO + checkpoint
+    reshard (mirrors __graft_entry__._dryrun_4d so the rung stays green)."""
+    import sys
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    import __graft_entry__ as graft
+
+    graft._dryrun_4d(8)

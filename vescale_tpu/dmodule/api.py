@@ -83,7 +83,29 @@ def _match(plan: Dict[str, Any], fqn: str):
 def _constrain(x, placements, mesh: DeviceMesh):
     if placements is None or not isinstance(x, (jax.Array, jnp.ndarray)) or np.isscalar(x):
         return x
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh.jax_mesh, pspec_of(placements, x.ndim, mesh)))
+    spec = pspec_of(placements, x.ndim, mesh)
+    # Inside a mesh context whose axis types differ from the plan's mesh
+    # (e.g. the compiled pipeline's shard_map with a Manual pp axis), a
+    # concrete NamedSharding would not match the context mesh — constrain
+    # with the bare PartitionSpec so jax resolves it against the context,
+    # dropping axes that are manual there (they're already local).
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx is not None and ctx.shape_tuple:  # non-empty context mesh
+        manual = {
+            n
+            for n, t in zip(ctx.axis_names, ctx.axis_types)
+            if t == jax.sharding.AxisType.Manual
+        }
+        def drop_manual(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, tuple):
+                kept = tuple(n for n in entry if n not in manual)
+                return kept if kept else None
+            return None if entry in manual else entry
+        spec = PartitionSpec(*(drop_manual(e) for e in spec))
+        return jax.lax.with_sharding_constraint(x, spec)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh.jax_mesh, spec))
 
 
 def _constrain_tree(tree, placements_list, mesh: DeviceMesh):

@@ -18,11 +18,14 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from ..placements import Replicate, Shard
+from ..placements import Shard, plan_axes
 
 __all__ = [
     "LlamaConfig",
     "Llama",
+    "LlamaBlock",
+    "LlamaEmbed",
+    "LlamaHead",
     "llama_plan",
     "LLAMA2_7B",
     "LLAMA3_8B",
@@ -196,33 +199,67 @@ class Llama(nn.Module):
         return nn.Dense(c.vocab_size, use_bias=False, dtype=c.dtype, name="lm_head")(x)
 
 
+class LlamaEmbed(nn.Module):
+    """Token-embedding pipeline unit (first-stage granularity; mirrors the
+    reference's smallest_unsplittable_units for HF llama, pipe_parser.py)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, idx):
+        c = self.config
+        return nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype, name="embed_tokens")(idx)
+
+
+class LlamaHead(nn.Module):
+    """Final-norm + LM-head pipeline unit (last-stage granularity)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        x = RMSNorm(c.rms_norm_eps, c.dtype, name="norm")(x)
+        return nn.Dense(c.vocab_size, use_bias=False, dtype=c.dtype, name="lm_head")(x)
+
+
 def llama_plan(mesh, sequence_parallel: bool = True):
-    """4D TP/SP plan over mesh dims ("dp", "tp")
-    (reference legacy/examples/open_llama_4D_benchmark/sharding_plan.py):
-    column-parallel q/k/v + gate/up, row-parallel o/down, hidden-sharded
-    embedding, vocab-sharded head; RMSNorms replicated with SP activations."""
-    R, S = Replicate(), Shard
-    dp_only = [S(0), R]
-    seq_par = [S(0), S(1)] if sequence_parallel else dp_only
+    """TP/SP plan (reference legacy/examples/open_llama_4D_benchmark/
+    sharding_plan.py): column-parallel q/k/v + gate/up, row-parallel o/down,
+    hidden-sharded embedding, vocab-sharded head; RMSNorms replicated with SP
+    activations.
+
+    Mesh-shape-agnostic: shardings bind to the mesh dims *named* "dp"/"tp"
+    (``plan_axes``), so the same plan works on ("dp","tp"), ("pp","dp","tp")
+    or 5-D meshes.  The fwd-plan FQN regexes tolerate a missing
+    ``layers_N.`` prefix so they also match a standalone ``LlamaBlock``
+    parallelized per pipeline stage.
+    """
+    S = Shard
+    col = plan_axes(mesh, tp=S(1))      # column-parallel kernel (in, out/tp)
+    row = plan_axes(mesh, tp=S(0))      # row-parallel kernel (in/tp, out)
+    rep = plan_axes(mesh)
+    dp_only = [plan_axes(mesh, dp=S(0))]
+    seq_par = [plan_axes(mesh, dp=S(0), tp=S(1))] if sequence_parallel else dp_only
     param_plan = {
-        r"embed_tokens\.embedding": [R, S(1)],
-        r"layers_\d+\.self_attn\.(q_proj|k_proj|v_proj)\.kernel": [R, S(1)],
-        r"layers_\d+\.self_attn\.o_proj\.kernel": [R, S(0)],
-        r"layers_\d+\.mlp\.(gate_proj|up_proj)\.kernel": [R, S(1)],
-        r"layers_\d+\.mlp\.down_proj\.kernel": [R, S(0)],
-        r"lm_head\.kernel": [R, S(1)],
-        r".*layernorm\.weight": [R, R],
-        r"norm\.weight": [R, R],
-        r".*": [R, R],
+        r"embed_tokens\.embedding": col,
+        r"(layers_\d+\.)?self_attn\.(q_proj|k_proj|v_proj)\.kernel": col,
+        r"(layers_\d+\.)?self_attn\.o_proj\.kernel": row,
+        r"(layers_\d+\.)?mlp\.(gate_proj|up_proj)\.kernel": col,
+        r"(layers_\d+\.)?mlp\.down_proj\.kernel": row,
+        r"lm_head\.kernel": col,
+        r".*layernorm\.weight": rep,
+        r"norm\.weight": rep,
+        r".*": rep,
     }
     fwd_plan = {
-        r"": {"input": [dp_only], "output": [dp_only]},
-        r"layers_\d+\.(input_layernorm|post_attention_layernorm)": {
-            "input": [seq_par],
-            "output": [seq_par],
+        r"": {"input": [dp_only[0]], "output": [dp_only[0]]},
+        r"(layers_\d+\.)?(input_layernorm|post_attention_layernorm)": {
+            "input": [seq_par[0]],
+            "output": [seq_par[0]],
         },
-        r"layers_\d+\.self_attn": {"input": [dp_only], "output": [dp_only]},
-        r"layers_\d+\.mlp": {"input": [dp_only], "output": [dp_only]},
-        r"norm": {"input": [seq_par], "output": [dp_only]},
+        r"(layers_\d+\.)?self_attn": {"input": [dp_only[0]], "output": [dp_only[0]]},
+        r"(layers_\d+\.)?mlp": {"input": [dp_only[0]], "output": [dp_only[0]]},
+        r"norm": {"input": [seq_par[0]], "output": [dp_only[0]]},
     }
     return {"parameter": param_plan, "forward": fwd_plan}

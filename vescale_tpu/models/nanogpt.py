@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from ..placements import Replicate, Shard
+from ..placements import Shard, plan_axes
 
 __all__ = ["GPTConfig", "GPT", "nanogpt_plan", "cross_entropy_loss"]
 
@@ -132,23 +132,28 @@ def nanogpt_plan(mesh, sequence_parallel: bool = True):
     run sequence-parallel (activations Shard(1) on seq over tp) and
     attn/mlp regions run tensor-parallel (activations gathered on seq).
     """
-    R, S = Replicate(), Shard
-    dp_only = [S(0), R]  # activations (B, T, E): batch over dp
-    seq_par = [S(0), S(1)] if sequence_parallel else dp_only
+    S = Shard
+    col = plan_axes(mesh, tp=S(1))
+    # column-parallel bias and row-parallel kernel both shard tensor dim 0 on tp
+    row = plan_axes(mesh, tp=S(0))
+    col_b = row
+    rep = plan_axes(mesh)
+    dp_only = plan_axes(mesh, dp=S(0))  # activations (B, T, E): batch over dp
+    seq_par = plan_axes(mesh, dp=S(0), tp=S(1)) if sequence_parallel else dp_only
     param_plan = {
-        r"wte\.embedding": [R, S(1)],
-        r"wpe\.embedding": [R, S(1)],
-        r"h_\d+\.attn\.c_attn\.kernel": [R, S(1)],
-        r"h_\d+\.attn\.c_attn\.bias": [R, S(0)],
-        r"h_\d+\.attn\.c_proj\.kernel": [R, S(0)],
-        r"h_\d+\.attn\.c_proj\.bias": [R, R],
-        r"h_\d+\.mlp\.c_fc\.kernel": [R, S(1)],
-        r"h_\d+\.mlp\.c_fc\.bias": [R, S(0)],
-        r"h_\d+\.mlp\.c_proj\.kernel": [R, S(0)],
-        r"h_\d+\.mlp\.c_proj\.bias": [R, R],
+        r"wte\.embedding": col,
+        r"wpe\.embedding": col,
+        r"h_\d+\.attn\.c_attn\.kernel": col,
+        r"h_\d+\.attn\.c_attn\.bias": col_b,
+        r"h_\d+\.attn\.c_proj\.kernel": row,
+        r"h_\d+\.attn\.c_proj\.bias": rep,
+        r"h_\d+\.mlp\.c_fc\.kernel": col,
+        r"h_\d+\.mlp\.c_fc\.bias": col_b,
+        r"h_\d+\.mlp\.c_proj\.kernel": row,
+        r"h_\d+\.mlp\.c_proj\.bias": rep,
         # LayerNorm scales/biases replicated (grads Partial-synced by GSPMD)
-        r".*ln_\d*\.(scale|bias)": [R, R],
-        r".*": [R, R],
+        r".*ln_\d*\.(scale|bias)": rep,
+        r".*": rep,
     }
     fwd_plan = {
         r"": {"input": [dp_only], "output": [dp_only]},
@@ -156,7 +161,7 @@ def nanogpt_plan(mesh, sequence_parallel: bool = True):
         r"h_\d+\.attn": {"input": [dp_only], "output": [dp_only]},
         r"h_\d+\.mlp": {"input": [dp_only], "output": [dp_only]},
         r"ln_f": {"input": [seq_par], "output": [dp_only]},
-    }
+    }  # activations bind to dims named "dp"/"tp" (plan_axes) — mesh-agnostic
     return {"parameter": param_plan, "forward": fwd_plan}
 
 

@@ -26,13 +26,48 @@ from jax.sharding import PartitionSpec as P
 from ..mesh import DeviceMesh
 from ..collectives import shard_map
 
-__all__ = ["pipeline_blocks", "stack_stage_params"]
+__all__ = ["pipeline_blocks", "stack_stage_params", "shard_stacked_params"]
 
 
 def stack_stage_params(params_list):
     """Stack per-stage param trees (same structure) along a new leading axis
     -> leaves (S, ...)."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *params_list)
+
+
+def shard_stacked_params(
+    stacked,
+    mesh: DeviceMesh,
+    param_plan,
+    pp_dim: str = "pp",
+    fqn_prefix: str = "",
+):
+    """Place pp-stacked per-stage block params by a DModule param plan.
+
+    Each leaf is (S, *block_shape): the stage axis is Shard-placed on
+    ``pp_dim`` and the block dims follow the plan's placements for
+    ``fqn_prefix + leaf_path`` (the same FQN-regex plans
+    ``parallelize_module`` consumes — reference dmodule/_dmodule.py:217
+    _distribute_parameter, applied to the compiled-pipeline layout).
+    Returns the tree with leaves ``jax.device_put`` onto the mesh.
+    """
+    from jax.sharding import NamedSharding
+
+    from ..dmodule.api import DModule, pspec_of
+    from ..placements import Replicate
+
+    dm = DModule(None, mesh, {"parameter": param_plan})
+    pp_index = mesh._dim_index(pp_dim)
+
+    def one(keypath, leaf):
+        path = ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath)
+        placements = list(dm.param_placements(fqn_prefix + path, leaf.ndim - 1))
+        placements[pp_index] = Replicate()  # pp is the stage axis, not a block dim
+        block_spec = pspec_of(placements, leaf.ndim - 1, mesh)
+        spec = P(pp_dim, *block_spec)
+        return jax.device_put(leaf, NamedSharding(mesh.jax_mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(one, stacked)
 
 
 def pipeline_blocks(

@@ -36,6 +36,7 @@ __all__ = [
     "StridedRaggedShard",
     "normalize_placement",
     "normalize_placements",
+    "plan_axes",
 ]
 
 
@@ -283,3 +284,20 @@ def normalize_placements(placements, mesh_ndim: int, tensor_ndim: Optional[int] 
         raise ValueError(f"{len(out)} placements for mesh of {mesh_ndim} dims")
     out.extend(Replicate() for _ in range(mesh_ndim - len(out)))
     return tuple(out)
+
+
+def plan_axes(mesh, **dims) -> list:
+    """Placements list for ``mesh`` with ``dims[name]`` at the mesh dim
+    *named* ``name`` and Replicate elsewhere.
+
+    Makes sharding plans mesh-shape-agnostic: the reference's plans are
+    positional lists tied to a fixed ("dp","tp") mesh
+    (legacy/examples/open_llama_4D_benchmark/sharding_plan.py); here the same
+    plan composes unchanged onto ("pp","dp","tp") or 5-D meshes — names
+    absent from the mesh are simply dropped (that axis stays unsharded).
+    """
+    out = [Replicate() for _ in range(len(mesh.mesh_dim_names))]
+    for name, p in dims.items():
+        if name in mesh.mesh_dim_names:
+            out[mesh.mesh_dim_names.index(name)] = normalize_placement(p)
+    return out
