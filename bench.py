@@ -59,10 +59,7 @@ def main():
             num_key_value_heads=16,
             max_position_embeddings=T,
             dtype=jnp.bfloat16,
-            # the pallas kernel is not GSPMD-partitionable: enable for the
-            # single-chip headline only (multi-chip attention goes through
-            # the ulysses/ring shard_map paths)
-            use_flash_attention=(n == 1),
+            use_flash_attention=True,  # GSPMD-partitionable (custom_partitioning)
         )
         metric = "llama350m_train_MFU_1chip_seq4096"
     else:

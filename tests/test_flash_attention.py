@@ -71,3 +71,57 @@ def test_block_fit_keeps_flash_path():
     out = flash_attention(q, k, v, interpret=True)  # defaults 512 -> fit 256
     golden = _dense_ref(q, k, v, 1.0 / np.sqrt(D), True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_gspmd_partitionable_no_shard_map():
+    """VERDICT r1 #2: flash == dense under a dp x tp mesh with PLAIN jit —
+    no shard_map in user code — via custom_partitioning, fwd and bwd, with
+    zero resharding of q/k/v (b/h sharded, t/d replicated)."""
+    import vescale_tpu as vt
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = vt.DeviceMesh(("dp", "tp"), (2, 4))
+    B, T, H, D = 4, 128, 4, 16
+    ks = jax.random.split(jax.random.key(3), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D)) for kk in ks)
+    sh = NamedSharding(mesh.jax_mesh, P("dp", None, "tp", None))
+    qs, ks_, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=True))
+    out = f(qs, ks_, vs)
+    golden = _dense_ref(q, k, v, 1.0 / np.sqrt(D), True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)
+    assert out.sharding.spec == P("dp", None, "tp")  # b/h sharding propagated
+
+    g = jax.jit(
+        jax.grad(
+            lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=True).sum(),
+            argnums=(0, 1, 2),
+        )
+    )(qs, ks_, vs)
+    gref = jax.grad(
+        lambda q, k, v: _dense_ref(q, k, v, 1.0 / np.sqrt(D), True).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(g, gref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+    # the partitioning rule means no all-gather of the seq dim is inserted
+    hlo = f.lower(qs, ks_, vs).compile().as_text()
+    assert "all-gather" not in hlo
+
+
+def test_flash_partitioned_seq_sharded_input_gathers():
+    """Seq-sharded q/k/v still computes correctly (t is a need-replication
+    factor: XLA gathers seq before the kernel rather than mis-partitioning)."""
+    import vescale_tpu as vt
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = vt.DeviceMesh(("dp", "tp"), (2, 4))
+    B, T, H, D = 2, 128, 4, 16
+    ks = jax.random.split(jax.random.key(4), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D)) for kk in ks)
+    sh = NamedSharding(mesh.jax_mesh, P("dp", "tp", None, None))  # seq-sharded
+    qs, ks_, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=True))(qs, ks_, vs)
+    golden = _dense_ref(q, k, v, 1.0 / np.sqrt(D), True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)

@@ -41,7 +41,20 @@ from ..mesh import DeviceMesh
 from ..placements import Placement, Replicate, Shard, normalize_placements
 from ..spec import DArraySpec, TensorMeta
 
-__all__ = ["parallelize_module", "DModule", "PlacementsInterface", "pspec_of"]
+__all__ = ["parallelize_module", "DModule", "PlacementsInterface", "pspec_of", "keypath_fqn"]
+
+
+def keypath_fqn(keypath) -> str:
+    """Dotted FQN for a jax tree keypath (DictKey/SequenceKey/etc.)."""
+    parts = []
+    for k in keypath:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return ".".join(parts)
 
 
 def pspec_of(placements, ndim: int, mesh: DeviceMesh) -> PartitionSpec:
@@ -154,16 +167,8 @@ class DModule:
         return normalize_placements(v, self.mesh.ndim, ndim)
 
     def _path_str(self, keypath) -> str:
-        parts = []
-        for k in keypath:
-            if hasattr(k, "key"):
-                parts.append(str(k.key))
-            elif hasattr(k, "idx"):
-                parts.append(str(k.idx))
-            else:
-                parts.append(str(k))
         # drop the leading collection name ("params")
-        return ".".join(parts[1:]) if len(parts) > 1 else ".".join(parts)
+        return keypath_fqn(keypath[1:] if len(keypath) > 1 else keypath)
 
     def variables_shardings(self, abstract_variables):
         """Tree of NamedSharding for a variables pytree (params sharded per

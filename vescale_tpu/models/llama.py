@@ -47,11 +47,11 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
-    # pallas fused kernel — single-chip jit programs only (the kernel is not
-    # GSPMD-partitionable; multi-chip attention goes through the ulysses/ring
-    # shard_map paths, or enable explicitly when attention inputs are
-    # unsharded on the attention dims)
-    use_flash_attention: bool = False
+    # pallas fused kernel; GSPMD-partitionable over batch/head dims via
+    # custom_partitioning (ops/flash_attention.py), so it composes with plain
+    # jit + dp/tp meshes.  Seq-sharded long-context uses ring/ulysses
+    # (parallel/context.py) instead.  Off-TPU it falls back to dense math.
+    use_flash_attention: bool = True
     remat: bool = False  # jax.checkpoint each block (HBM for FLOPs)
     dtype: Any = jnp.bfloat16
 
@@ -239,8 +239,8 @@ def llama_plan(mesh, sequence_parallel: bool = True):
     col = plan_axes(mesh, tp=S(1))      # column-parallel kernel (in, out/tp)
     row = plan_axes(mesh, tp=S(0))      # row-parallel kernel (in/tp, out)
     rep = plan_axes(mesh)
-    dp_only = [plan_axes(mesh, dp=S(0))]
-    seq_par = [plan_axes(mesh, dp=S(0), tp=S(1))] if sequence_parallel else dp_only
+    dp_only = plan_axes(mesh, dp=S(0))
+    seq_par = plan_axes(mesh, dp=S(0), tp=S(1)) if sequence_parallel else dp_only
     param_plan = {
         r"embed_tokens\.embedding": col,
         r"(layers_\d+\.)?self_attn\.(q_proj|k_proj|v_proj)\.kernel": col,
@@ -253,13 +253,13 @@ def llama_plan(mesh, sequence_parallel: bool = True):
         r".*": rep,
     }
     fwd_plan = {
-        r"": {"input": [dp_only[0]], "output": [dp_only[0]]},
+        r"": {"input": [dp_only], "output": [dp_only]},
         r"(layers_\d+\.)?(input_layernorm|post_attention_layernorm)": {
-            "input": [seq_par[0]],
-            "output": [seq_par[0]],
+            "input": [seq_par],
+            "output": [seq_par],
         },
-        r"(layers_\d+\.)?self_attn": {"input": [dp_only[0]], "output": [dp_only[0]]},
-        r"(layers_\d+\.)?mlp": {"input": [dp_only[0]], "output": [dp_only[0]]},
-        r"norm": {"input": [seq_par[0]], "output": [dp_only[0]]},
+        r"(layers_\d+\.)?self_attn": {"input": [dp_only], "output": [dp_only]},
+        r"(layers_\d+\.)?mlp": {"input": [dp_only], "output": [dp_only]},
+        r"norm": {"input": [seq_par], "output": [dp_only]},
     }
     return {"parameter": param_plan, "forward": fwd_plan}

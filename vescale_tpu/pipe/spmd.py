@@ -53,14 +53,14 @@ def shard_stacked_params(
     """
     from jax.sharding import NamedSharding
 
-    from ..dmodule.api import DModule, pspec_of
+    from ..dmodule.api import DModule, keypath_fqn, pspec_of
     from ..placements import Replicate
 
     dm = DModule(None, mesh, {"parameter": param_plan})
     pp_index = mesh._dim_index(pp_dim)
 
     def one(keypath, leaf):
-        path = ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath)
+        path = keypath_fqn(keypath)
         placements = list(dm.param_placements(fqn_prefix + path, leaf.ndim - 1))
         placements[pp_index] = Replicate()  # pp is the stage axis, not a block dim
         block_spec = pspec_of(placements, leaf.ndim - 1, mesh)

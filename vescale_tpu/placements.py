@@ -300,4 +300,12 @@ def plan_axes(mesh, **dims) -> list:
     for name, p in dims.items():
         if name in mesh.mesh_dim_names:
             out[mesh.mesh_dim_names.index(name)] = normalize_placement(p)
+        elif len(mesh.mesh_dim_names) > 1:
+            import warnings
+
+            warnings.warn(
+                f"plan_axes: mesh {mesh.mesh_dim_names} has no dim named {name!r}; "
+                "that axis stays unsharded (replicated)",
+                stacklevel=2,
+            )
     return out
