@@ -13,13 +13,19 @@ shapes are static at trace time).  The compiled whole-pipeline path lives in
 spmd.py.
 
 Backward decomposition: FORWARD records a ``jax.vjp`` pullback per (group,
-microbatch).  BACKWARD calls it and accumulates weight grads immediately;
-BACKWARD_DGRAD propagates only the input cotangent and stashes the weight
-grad for a later BACKWARD_WGRAD (zero-bubble), matching the reference's
-dgrad/wgrad split (zero_bubble_v.py)."""
+microbatch) for fused-backward schedules.  For zero-bubble schedules FORWARD
+records a ``jax.linearize`` instead, and the backward is split for real
+(reference zero_bubble_v.py:132 ScheduledNode B/W):
+BACKWARD_DGRAD transposes the linearized map w.r.t. the *input only*
+(``jax.linear_transpose`` with the params tangent pinned to zero) — the
+weight-grad matmuls do NOT run; BACKWARD_WGRAD later transposes w.r.t. the
+*params only*, actually computing the deferred weight grads in the bubble
+slots.  Both transposes share the single linearization's residuals, so the
+forward runs once."""
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -29,7 +35,39 @@ from ..plan import PipelineParallelPlan
 from .pipe_stage import PipeModule
 from .schedules import Instruction, InstructionKind, build_schedule
 
-__all__ = ["PipeEngine"]
+__all__ = ["PipeEngine", "PendingWgrad"]
+
+
+def _zero_tangent(x):
+    """Zero tangent for a primal (float0 for integer leaves, e.g. tokens)."""
+    import numpy as np
+
+    dt = jnp.result_type(x)
+    if jnp.issubdtype(dt, jnp.inexact):
+        return jnp.zeros(jnp.shape(x), dt)
+    return np.zeros(jnp.shape(x), jax.dtypes.float0)
+
+
+@dataclasses.dataclass
+class PendingWgrad:
+    """A deferred weight-grad: everything needed to compute dparams later.
+
+    Holding (f_lin, dy) rather than a computed dparams is the observable
+    difference from a fake split — the wgrad matmuls run when
+    BACKWARD_WGRAD executes, not at dgrad time."""
+
+    f_lin: Callable        # linearized (dp, dx) -> dy_out map (shares residuals)
+    dy: Any                # output cotangent for this (group, microbatch)
+    params_example: Any    # primal params (structure + zeros for the transpose)
+    x_example: Any         # primal input
+
+    def compute(self):
+        zero_x = jax.tree_util.tree_map(_zero_tangent, self.x_example)
+        wgrad_t = jax.linear_transpose(
+            lambda pp: self.f_lin(pp, zero_x), self.params_example
+        )
+        (dparams,) = wgrad_t(self.dy)
+        return dparams
 
 
 class PipeEngine:
@@ -94,10 +132,17 @@ class PipeEngine:
                 for stage_ins in schedule
             ]
 
+        # split-backward (zero-bubble) schedules linearize at FORWARD time so
+        # dgrad/wgrad can be transposed independently later
+        uses_split = any(
+            i.kind == InstructionKind.BACKWARD_DGRAD for stage_ins in schedule for i in stage_ins
+        )
+
         acts: Dict[Tuple[int, int], Any] = {}       # (g, m) -> output
         pullbacks: Dict[Tuple[int, int], Any] = {}
+        linears: Dict[Tuple[int, int], Any] = {}     # (g, m) -> (f_lin, params, x)
         cotangents: Dict[Tuple[int, int], Any] = {}  # (g, m) -> dy for group g
-        wgrad_stash: Dict[Tuple[int, int], Any] = {}
+        wgrad_stash: Dict[Tuple[int, int], PendingWgrad] = {}
         losses: Dict[int, Any] = {}
         outputs: Dict[int, Any] = {}  # forward-only: last-group outputs per microbatch
         grads: List[Optional[Dict[str, Any]]] = [None] * G
@@ -108,7 +153,7 @@ class PipeEngine:
             if ins.kind == InstructionKind.FORWARD:
                 return g == 0 or (g - 1, m) in acts
             if ins.kind in (InstructionKind.BACKWARD, InstructionKind.BACKWARD_DGRAD):
-                if (g, m) not in pullbacks:
+                if (g, m) not in pullbacks and (g, m) not in linears:
                     return False
                 return g == G - 1 or (g, m) in cotangents
             if ins.kind == InstructionKind.BACKWARD_WGRAD:
@@ -133,19 +178,23 @@ class PipeEngine:
                         acts[(g, m)] = y
                     else:
                         acts[(g, m)] = fwd(params_per_group[g], x)
-                elif g == G - 1:
+                    return
+                if g == G - 1:
                     def f(p, xx):
                         return self.loss_fn(fwd(p, xx), targets[m]["target"])
-
-                    loss, pb = jax.vjp(f, params_per_group[g], x)
-                    losses[m] = loss
-                    pullbacks[(g, m)] = pb
-                    acts[(g, m)] = loss
                 else:
-                    y, pb = jax.vjp(fwd, params_per_group[g], x)
-                    acts[(g, m)] = y
+                    f = fwd
+                p = params_per_group[g]
+                if uses_split:
+                    y, f_lin = jax.linearize(f, p, x)
+                    linears[(g, m)] = (f_lin, p, x)
+                else:
+                    y, pb = jax.vjp(f, p, x)
                     pullbacks[(g, m)] = pb
-            elif ins.kind in (InstructionKind.BACKWARD, InstructionKind.BACKWARD_DGRAD):
+                acts[(g, m)] = y
+                if g == G - 1:
+                    losses[m] = y
+            elif ins.kind == InstructionKind.BACKWARD:
                 pb = pullbacks.pop((g, m))
                 dy = (
                     jnp.asarray(1.0 / M, dtype=losses[m].dtype)
@@ -155,12 +204,24 @@ class PipeEngine:
                 dparams, dx = pb(dy)
                 if g > 0:
                     cotangents[(g - 1, m)] = dx
-                if ins.kind == InstructionKind.BACKWARD:
-                    _accumulate(grads, g, dparams)
-                else:
-                    wgrad_stash[(g, m)] = dparams
+                _accumulate(grads, g, dparams)
+            elif ins.kind == InstructionKind.BACKWARD_DGRAD:
+                f_lin, p, x = linears.pop((g, m))
+                dy = (
+                    jnp.asarray(1.0 / M, dtype=losses[m].dtype)
+                    if g == G - 1
+                    else cotangents.pop((g, m))
+                )
+                if g > 0:
+                    # input-grad only: transpose the linear map in its x slot
+                    # (params tangent pinned to zero — no weight-grad matmuls)
+                    zero_p = jax.tree_util.tree_map(_zero_tangent, p)
+                    dgrad_t = jax.linear_transpose(lambda xx: f_lin(zero_p, xx), x)
+                    (dx,) = dgrad_t(dy)
+                    cotangents[(g - 1, m)] = dx
+                wgrad_stash[(g, m)] = PendingWgrad(f_lin, dy, p, x)
             elif ins.kind == InstructionKind.BACKWARD_WGRAD:
-                _accumulate(grads, g, wgrad_stash.pop((g, m)))
+                _accumulate(grads, g, wgrad_stash.pop((g, m)).compute())
 
         # round-robin clock over stages, dependency-driven (the reference's
         # per-rank executors run concurrently; single-controller execution
