@@ -61,8 +61,18 @@ def redistribute(darr: DArray, placements, mesh: Optional[DeviceMesh] = None) ->
     if trivial:
         return DArray(_apply_sharding(darr.data, dst), dst)
 
-    logical = src.unpack(darr.data)
-    phys = dst.pack(logical)
+    # Per-shard transition kernels (transfer.py): each rank touches only its
+    # shard; the collective is the exact reference-table op (all-gather /
+    # reduce-scatter / all-to-all / ...) — no logical-size allocation.
+    from .transfer import fallback_fn, transition_fn
+
+    fn = transition_fn(src, dst)
+    if fn is not None:
+        return DArray(fn(darr.data), dst)
+
+    # fallback (ragged / interleaved / nested / cross-mesh): pack∘unpack,
+    # jit-compiled with the destination sharding where possible
+    phys = fallback_fn(src, dst)(darr.data)
     return DArray(_apply_sharding(phys, dst), dst)
 
 
