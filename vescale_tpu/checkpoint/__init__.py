@@ -50,13 +50,47 @@ def _storage_for(path: str) -> Storage:
 
 
 class CheckpointHandle:
-    """Async-save handle (reference async_checkpoint=True semantics)."""
+    """Async-save handle (reference async_checkpoint=True semantics).
 
-    def __init__(self, writer: AsyncWriter):
+    ``wait()`` drains the io workers, then runs the commit step (barrier +
+    meta write) on the CALLING thread — a device-collective barrier from an
+    io pool thread could interleave with main-thread collectives and
+    deadlock a multi-process run."""
+
+    def __init__(self, writer: AsyncWriter, commit=None):
         self._writer = writer
+        self._commit = commit
+        self._done = False
 
     def wait(self) -> None:
+        if self._done:
+            return
         self._writer.shutdown()
+        if self._commit is not None:
+            self._commit()
+        self._done = True
+
+
+def _writer_process(leaf, owner, chunk_idx: int, nproc: int, proc_of: Dict[int, int]) -> int:
+    """Deterministic, load-balanced choice of which process writes a chunk
+    (reference dedup_plans + DP-rank-0-write, vescale_planner.py:132,137).
+    Every process computes the same answer from the global plan."""
+    if nproc == 1:
+        return 0
+    from ..darray import DArray
+
+    if isinstance(leaf, DArray):
+        # multi-process DArray saves are gated out in save(); the eager
+        # to_local fetch and the Partial-normalizing redistribute are
+        # single-controller operations that would diverge across processes
+        raise NotImplementedError(
+            "multi-process save of DArray leaves: pass the physical array "
+            "(darr.data, a sharded jax.Array) instead"
+        )
+    if isinstance(owner, tuple):  # jax.Array: device ids holding this chunk
+        procs = sorted({proc_of[i] for i in owner if i in proc_of})
+        return procs[chunk_idx % len(procs)]
+    return chunk_idx % nproc  # host-replicated leaves: round-robin
 
 
 def save(
@@ -67,10 +101,16 @@ def save(
 ) -> Optional[CheckpointHandle]:
     """Save a state dict of pytrees (reference checkpoint/__init__.py:16).
 
-    Leaves may be DArray, sharded jax.Array, numpy, or python scalars."""
+    Leaves may be DArray, sharded jax.Array, numpy, or python scalars.
+    Multi-process: each process writes only the chunks it owns (per-process
+    writes with cross-replica dedup); process 0 commits ``meta.json`` after
+    a barrier, so a reader never sees a torn checkpoint."""
     storage = _storage_for(path)
     writer = AsyncWriter(storage, num_io_workers)
     meta: Dict[str, Any] = {"arrays": {}}
+    me = jax.process_index()
+    nproc = jax.process_count()
+    proc_of = {d.id: d.process_index for d in jax.devices()} if nproc > 1 else {}
 
     for top_key, tree in checkpoint_state.items():
         flat = flatten_state(tree)
@@ -87,21 +127,26 @@ def save(
             for i, (box, owner) in enumerate(chunk_plan):
                 fname = f"data/{full_key}/{i}.npy"
                 entry["chunks"].append({**box.to_json(), "file": fname})
-                writer.submit(fname, fetch_chunk(leaf, box, owner))
+                if _writer_process(leaf, owner, i, nproc, proc_of) == me:
+                    writer.submit(fname, fetch_chunk(leaf, box, owner))
             meta["arrays"][full_key] = entry
 
     # meta.json is the commit marker: it must hit storage only after every
-    # data chunk is durable, so a reader never sees a torn checkpoint
-    def _finalize(data_futures):
-        for f in data_futures:
-            f.result()
-        storage.write_bytes("meta.json", json.dumps(meta).encode())
+    # data chunk (on every process) is durable.  The commit runs on the
+    # CALLING thread via CheckpointHandle.wait (barrier is a device
+    # collective — never issue it from an io worker thread).
+    def _commit():
+        if nproc > 1:
+            from ..distributed import barrier
 
-    data_futures = list(writer.futures)
-    writer.futures = [writer.pool.submit(_finalize, data_futures)]
+            barrier(f"ckpt_save:{path}")
+        if me == 0:
+            storage.write_bytes("meta.json", json.dumps(meta).encode())
+
+    handle = CheckpointHandle(writer, _commit)
     if async_checkpoint:
-        return CheckpointHandle(writer)
-    writer.shutdown()
+        return handle
+    handle.wait()
     return None
 
 
@@ -168,16 +213,21 @@ def _relayout(full: np.ndarray, target_leaf):
             full.astype(np.dtype(target_leaf.dtype)), target_leaf.mesh, target_leaf.placements
         )
     if isinstance(target_leaf, jax.Array):
-        val = jnp.asarray(full, dtype=target_leaf.dtype)
-        if tuple(val.shape) != tuple(target_leaf.shape):
-            raise ValueError(f"shape mismatch: saved {val.shape} vs template {target_leaf.shape}")
+        host = full.astype(np.dtype(target_leaf.dtype), copy=False)
+        if tuple(host.shape) != tuple(target_leaf.shape):
+            raise ValueError(f"shape mismatch: saved {host.shape} vs template {target_leaf.shape}")
         from jax.sharding import NamedSharding
 
         if isinstance(target_leaf.sharding, NamedSharding):
-            return jax.device_put(val, target_leaf.sharding)
+            # make_array_from_callback places only this process's
+            # addressable shards — multi-process safe (device_put of a host
+            # value to a process-spanning sharding is not)
+            return jax.make_array_from_callback(
+                tuple(host.shape), target_leaf.sharding, lambda idx: host[idx]
+            )
         # single-device/uncommitted leaves (e.g. optimizer step counters):
         # keep uncommitted so jit may co-locate them with the params
-        return val
+        return jnp.asarray(host)
     arr = np.asarray(full)
     if np.isscalar(target_leaf) or (hasattr(target_leaf, "ndim") and target_leaf.ndim == 0):
         return arr.reshape(()).item() if not hasattr(target_leaf, "dtype") else arr.reshape(())

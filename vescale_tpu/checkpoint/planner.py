@@ -77,9 +77,17 @@ def array_plan(leaf) -> Tuple[Tuple[int, ...], str, List[Tuple[Box, Any]]]:
         spec = leaf.spec
         return tuple(spec.shape), np.dtype(spec.dtype).name, list(chunks_for_spec(spec))
     if isinstance(leaf, jax.Array):
-        seen: Dict[Tuple, Box] = {}
-        for sh in leaf.addressable_shards:
-            idx = sh.index
+        # GLOBAL plan (multi-process): every process derives the same chunk
+        # list from the sharding's full device->index map; the owner records
+        # the device ids holding each chunk so save() can dedup replicas
+        # across processes with load balance (reference dedup_plans,
+        # vescale_planner.py:132,137)
+        seen: Dict[Tuple, List[int]] = {}
+        try:
+            imap = leaf.sharding.devices_indices_map(leaf.shape)
+        except Exception:  # uncommitted single-device leaf
+            imap = {d: tuple(slice(None) for _ in leaf.shape) for d in leaf.devices()}
+        for dev, idx in imap.items():
             off = tuple(int(s.start or 0) for s in idx)
             size = tuple(
                 int((s.stop if s.stop is not None else dim) - (s.start or 0))
@@ -87,9 +95,11 @@ def array_plan(leaf) -> Tuple[Tuple[int, ...], str, List[Tuple[Box, Any]]]:
             )
             if not idx:  # scalar
                 off, size = (), ()
-            if (off, size) not in seen:
-                seen[(off, size)] = Box(off, size)
-        return tuple(leaf.shape), np.dtype(leaf.dtype).name, [(b, b) for b in seen.values()]
+            seen.setdefault((off, size), []).append(int(dev.id))
+        plan = [
+            (Box(off, size), tuple(sorted(ids))) for (off, size), ids in sorted(seen.items())
+        ]
+        return tuple(leaf.shape), np.dtype(leaf.dtype).name, plan
     arr = np.asarray(leaf)
     return tuple(arr.shape), arr.dtype.name, [(Box((0,) * arr.ndim, arr.shape), None)]
 

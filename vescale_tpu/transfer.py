@@ -31,13 +31,12 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 from .collectives import shard_map
 from .placements import Partial, Replicate, Shard
 from .spec import DArraySpec
 
-__all__ = ["transition_fn"]
+__all__ = ["transition_fn", "fallback_fn"]
 
 
 def _single_shard_map(spec: DArraySpec) -> Optional[Dict[int, int]]:
