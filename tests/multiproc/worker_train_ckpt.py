@@ -31,11 +31,12 @@ assert jax.device_count() == 8 and jax.local_device_count() == 4
 
 mesh = vdist.hybrid_device_mesh(("dp", "tp"), ici_shape=(4,), dcn_shape=(2,))
 assert mesh.shape == (2, 4)
-# dp must span the two processes (DCN), tp must stay within one (ICI)
+# dp must span the two processes (DCN); each tp row stays within one (ICI)
 devs = mesh.jax_mesh.devices
-assert {d.process_index for d in devs[0]} != {d.process_index for d in devs[1]} or (
-    len({d.process_index for d in devs.flat}) == 2
-)
+row0 = {d.process_index for d in devs[0]}
+row1 = {d.process_index for d in devs[1]}
+assert len(row0) == 1 and len(row1) == 1, (row0, row1)
+assert row0 != row1, (row0, row1)
 
 rng = np.random.default_rng(0)
 wnp = rng.normal(size=(16, 32)).astype(np.float32)

@@ -12,8 +12,10 @@ import optax
 import vescale_tpu as vt
 from vescale_tpu.dmodule import parallelize_module, pspec_of
 from vescale_tpu.models.nanogpt import GPT, GPTConfig, cross_entropy_loss, nanogpt_plan
-from vescale_tpu.placements import Replicate, Shard
+from vescale_tpu.placements import InterleavedShard, Replicate, Shard
 from vescale_tpu.train import make_train_step
+
+import flax.linen as nn
 
 CFG = GPTConfig(block_size=32, vocab_size=128, n_layer=2, n_head=4, n_embd=64, dropout=0.0)
 
@@ -162,3 +164,140 @@ def test_vedevicemesh_nanogpt_e2e():
     assert "tp" in str(k.sharding.spec)
     out = dm.apply(v, jnp.ones((2, 8), jnp.int32))
     assert out.shape == (2, 8, CFG.vocab_size)
+
+
+# ------------------------------------------------------------- hardening r2
+class _KwModel(nn.Module):
+    @nn.compact
+    def __call__(self, x, scale=None):
+        h = nn.Dense(32, name="fc")(x)
+        if scale is not None:
+            h = h * scale
+        return h
+
+
+def test_fwd_plan_reshards_kwargs(mesh2d):
+    """Reference _hook.py:76 reshards full input trees; kwargs included."""
+    model = _KwModel()
+    plan = {"forward": {r"": {"input": [[Shard(0), Replicate()]]}}}
+    dm = parallelize_module(model, mesh2d, plan)
+    v = dm.init(jax.random.key(0), jnp.ones((4, 16)))
+    x = jnp.ones((4, 16))
+    scale = jnp.full((4, 32), 2.0)
+
+    @jax.jit
+    def f(v, x, scale):
+        return dm.apply(v, x, scale=scale)
+
+    out = f(v, x, scale)
+    ref = dm.apply(v, x) * 2.0
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+    # the kwarg leaf got the broadcast constraint (sharded over dp)
+    assert "dp" in str(out.sharding.spec)
+
+
+def test_fwd_plan_method_scoped(mesh2d):
+    """``fqn:method`` plan keys bind non-__call__ methods (e.g. a tied
+    embedding's attend)."""
+    class Tied(nn.Module):
+        @nn.compact
+        def __call__(self, idx):
+            emb = nn.Embed(64, 16, name="emb")
+            return emb.attend(emb(idx))
+
+    constrained = {}
+    plan = {"forward": {r"emb:attend": {"output": [[Shard(0), Replicate()]]}}}
+    dm = parallelize_module(Tied(), mesh2d, plan)
+    v = dm.init(jax.random.key(0), jnp.ones((4, 8), jnp.int32))
+    out = jax.jit(lambda v, x: dm.apply(v, x))(v, jnp.ones((4, 8), jnp.int32))
+    assert out.shape == (4, 8, 64)
+    assert r"emb:attend" in dm._fwd_matched
+
+
+def test_plan_warns_on_unmatched_patterns(mesh2d):
+    """Typo'd FQN regexes must not silently no-op (VERDICT r1 next #8)."""
+    model = _KwModel()
+    bad_plan = {
+        "parameter": {r"fc_TYPO\.kernel": [Replicate(), Shard(1)], r".*": [Replicate(), Replicate()]},
+        "forward": {r"does_not_exist": {"input": [[Shard(0), Replicate()]]}},
+    }
+    dm = parallelize_module(model, mesh2d, bad_plan)
+    with pytest.warns(UserWarning, match="parameter plan patterns matched nothing"):
+        v = dm.init(jax.random.key(0), jnp.ones((4, 16)))
+    with pytest.warns(UserWarning, match="forward plan patterns matched nothing"):
+        dm.apply(v, jnp.ones((4, 16)))
+
+
+def test_nested_dmodule(mesh2d):
+    """A DModule used inside another DModule's apply: both interceptors
+    compose (nested intercept_methods contexts)."""
+    inner = parallelize_module(
+        _KwModel(), mesh2d, {"forward": {r"": {"output": [[Shard(0), Replicate()]]}}}
+    )
+    v_inner = inner.init(jax.random.key(0), jnp.ones((4, 16)))
+
+    class Outer(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(8, name="head")(inner.apply(v_inner, x))
+
+    outer = parallelize_module(
+        Outer(), mesh2d, {"forward": {r"": {"input": [[Shard(0), Replicate()]]}}}
+    )
+    v = outer.init(jax.random.key(1), jnp.ones((4, 16)))
+    out = jax.jit(lambda v, x: outer.apply(v, x))(v, jnp.ones((4, 16)))
+    assert out.shape == (4, 8) and bool(jnp.isfinite(out).all())
+
+
+def test_interleaved_shard_qkv_e2e(mesh2d):
+    """End-to-end InterleavedShard use: a merged-QKV weight distributed with
+    InterleavedShard(1, 3) over tp gives every rank aligned q/k/v head
+    slices, so per-rank attention in shard_map matches the dense global
+    computation (the reference's merged-QKV use case,
+    placement_types.py:284)."""
+    from vescale_tpu.collectives import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    E, H, hd, T = 32, 4, 8, 8
+    tp = 4
+    key = jax.random.key(3)
+    k1, k2 = jax.random.split(key)
+    wqkv = jax.random.normal(k1, (E, 3 * E)) * 0.1
+    x = jax.random.normal(k2, (2, T, E))
+
+    mesh = vt.DeviceMesh(("tp",), (tp,))
+    d = vt.distribute_tensor(wqkv, mesh, [InterleavedShard(1, 3)])
+    # each rank's local (E, 3*E/tp) = [q_r | k_r | v_r] aligned head groups
+    local = d.to_local(1)
+    np.testing.assert_allclose(
+        np.asarray(local),
+        np.concatenate(
+            [np.asarray(wqkv[:, s * E + (E // tp) * 1: s * E + (E // tp) * 2]) for s in range(3)],
+            axis=1,
+        ),
+    )
+
+    def rank_attn(w_loc, x):
+        # local heads only — no communication inside.  w_loc: the physical
+        # interleave layout's local block (E, 3, E/tp) = aligned q/k/v chunks
+        hp = H // tp
+        B = x.shape[0]
+        q = (x @ w_loc[:, 0, :]).reshape(B, T, hp, hd)
+        k = (x @ w_loc[:, 1, :]).reshape(B, T, hp, hd)
+        v = (x @ w_loc[:, 2, :]).reshape(B, T, hp, hd)
+        att = jax.nn.softmax(jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd), axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, hp * hd)
+
+    out = shard_map(
+        rank_attn,
+        mesh=mesh.jax_mesh,
+        in_specs=(P(None, None, "tp"), P()),
+        out_specs=P(None, None, "tp"),
+    )(d.data, x)
+    # golden: dense attention over ALL heads
+    qkv = x @ wqkv
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(2, T, H, hd); k = k.reshape(2, T, H, hd); v = v.reshape(2, T, H, hd)
+    att = jax.nn.softmax(jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd), axis=-1)
+    golden = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(2, T, E)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)

@@ -104,7 +104,9 @@ def save(
     Leaves may be DArray, sharded jax.Array, numpy, or python scalars.
     Multi-process: each process writes only the chunks it owns (per-process
     writes with cross-replica dedup); process 0 commits ``meta.json`` after
-    a barrier, so a reader never sees a torn checkpoint."""
+    a barrier, so a reader never sees a torn checkpoint.  NOTE: with
+    ``async_checkpoint=True`` under multi-process, the returned handle MUST
+    be ``wait()``ed — the commit barrier runs on the calling thread."""
     storage = _storage_for(path)
     writer = AsyncWriter(storage, num_io_workers)
     meta: Dict[str, Any] = {"arrays": {}}
@@ -143,7 +145,23 @@ def save(
         if me == 0:
             storage.write_bytes("meta.json", json.dumps(meta).encode())
 
-    handle = CheckpointHandle(writer, _commit)
+    if nproc == 1:
+        # single-process: no barrier needed, so the commit can chase the
+        # data futures on the io pool — fire-and-forget async saves stay
+        # durable even if the caller never wait()s (round-1 semantics)
+        data_futures = list(writer.futures)
+
+        def _finalize():
+            for f in data_futures:
+                f.result()
+            _commit()
+
+        writer.futures = writer.futures + [writer.pool.submit(_finalize)]
+        handle = CheckpointHandle(writer)
+    else:
+        # multi-process: the commit includes a device-collective barrier and
+        # MUST run on the calling thread — callers must wait() the handle
+        handle = CheckpointHandle(writer, _commit)
     if async_checkpoint:
         return handle
     handle.wait()

@@ -117,6 +117,8 @@ def hybrid_device_mesh(
             dcn_mesh_shape=dcn_shape + (1,) * len(ici_shape),
         )
     except Exception:
+        if jax.devices()[0].platform == "tpu":
+            raise  # a real topology error must not silently degrade to DCN TP
         # no attached TPU topology (CPU multi-process test rig): jax.devices()
         # is process-major, so a plain reshape puts leading dims across
         # processes (= DCN) and trailing dims within a process (= ICI)

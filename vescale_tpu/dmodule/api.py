@@ -86,11 +86,12 @@ class PlacementsInterface:
         return cls(input=v)
 
 
-def _match(plan: Dict[str, Any], fqn: str):
+def _match(plan: Dict[str, Any], fqn: str) -> Tuple[Optional[str], Any]:
+    """(pattern, value) of the first plan entry fullmatching ``fqn``."""
     for pattern, v in plan.items():
         if re.fullmatch(pattern, fqn):
-            return v
-    return None
+            return pattern, v
+    return None, None
 
 
 def _constrain(x, placements, mesh: DeviceMesh):
@@ -121,18 +122,43 @@ def _constrain(x, placements, mesh: DeviceMesh):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh.jax_mesh, spec))
 
 
+def _constrain_entry(entry, placements, mesh: DeviceMesh):
+    """Constrain every array leaf of one top-level entry (an arg, kwarg or
+    output element — possibly itself a pytree) with the same placements."""
+    if placements is None:
+        return entry
+    return jax.tree_util.tree_map(lambda leaf: _constrain(leaf, placements, mesh), entry)
+
+
+def _align_placements(placements_list, n: int):
+    pl = list(placements_list)
+    if len(pl) == 1 and n > 1:
+        pl = pl * n
+    return pl + [None] * (n - len(pl))
+
+
+def _constrain_inputs(args, kwargs, placements_list, mesh: DeviceMesh):
+    """Reshard the FULL input tree — positional and keyword args alike
+    (reference _hook.py:76 PreHookInput).  Placement entries align with the
+    top-level entries in order (args, then kwargs in call order); each entry
+    constrains all array leaves of that argument's subtree; a single entry
+    broadcasts to every argument."""
+    if placements_list is None:
+        return args, kwargs
+    entries = list(args) + list(kwargs.values())
+    pl = _align_placements(placements_list, len(entries))
+    out = [_constrain_entry(e, p, mesh) for e, p in zip(entries, pl)]
+    return tuple(out[: len(args)]), dict(zip(kwargs.keys(), out[len(args):]))
+
+
 def _constrain_tree(tree, placements_list, mesh: DeviceMesh):
-    leaves = tree if isinstance(tree, (tuple, list)) else (tree,)
+    """Output resharding: placements align with the top-level elements of a
+    tuple/list output (a single non-sequence output is one entry)."""
     if placements_list is None:
         return tree
-    # one placements entry per leaf; a single entry broadcasts
-    pl = list(placements_list)
-    if len(pl) == 1 and len(leaves) > 1:
-        pl = pl * len(leaves)
-    out = [
-        _constrain(leaf, p, mesh) if p is not None else leaf
-        for leaf, p in zip(leaves, pl + [None] * (len(leaves) - len(pl)))
-    ]
+    entries = list(tree) if isinstance(tree, (tuple, list)) else [tree]
+    pl = _align_placements(placements_list, len(entries))
+    out = [_constrain_entry(e, p, mesh) for e, p in zip(entries, pl)]
     if isinstance(tree, tuple):
         return tuple(out)
     if isinstance(tree, list):
@@ -151,20 +177,53 @@ class DModule:
         out = dmodel.apply(variables, x)       # boundary resharding applied
     """
 
-    def __init__(self, module: nn.Module, device_mesh: DeviceMesh, sharding_plan: Dict[str, Any]):
+    def __init__(
+        self,
+        module: nn.Module,
+        device_mesh: DeviceMesh,
+        sharding_plan: Dict[str, Any],
+        validate_plan: bool = True,
+    ):
         self.module = module
         self.mesh = device_mesh
+        self.validate_plan = validate_plan
         plan = sharding_plan or {}
         self.param_plan: Dict[str, Any] = dict(plan.get("parameter", {}))
         self.fwd_plan: Dict[str, PlacementsInterface] = {
             k: PlacementsInterface.normalize(v) for k, v in dict(plan.get("forward", {})).items()
         }
         self.default_input_placements = plan.get("default_input", None)
+        self._fwd_matched: set = set()
+        self._param_matched: set = set()
+        self._warned_fwd = False
 
     # --------------------------------------------------------- param plans
     def param_placements(self, path: str, ndim: int) -> Tuple[Placement, ...]:
-        v = _match(self.param_plan, path)
+        pattern, v = _match(self.param_plan, path)
+        if pattern is not None:
+            self._param_matched.add(pattern)
         return normalize_placements(v, self.mesh.ndim, ndim)
+
+    def _warn_unmatched(self, plan: Dict[str, Any], matched: set, kind: str) -> None:
+        import warnings
+
+        unmatched = [p for p in plan if p not in matched and p != r".*"]
+        if unmatched and self.validate_plan:
+            warnings.warn(
+                f"{kind} plan patterns matched nothing: {unmatched} — "
+                "typo'd FQN regexes silently leave params/activations "
+                "unconstrained (reference plans are validated the same way)",
+                stacklevel=3,
+            )
+
+    def _warn_unmatched_fwd_once(self) -> None:
+        if self._warned_fwd or not self.fwd_plan:
+            return
+        self._warned_fwd = True
+        # method-scoped entries ("fqn:method") often bind paths the first
+        # apply never takes (e.g. decode-only attend) — exclude them
+        call_plan = {p: v for p, v in self.fwd_plan.items() if ":" not in p}
+        self._warn_unmatched(call_plan, self._fwd_matched, "forward")
 
     def _path_str(self, keypath) -> str:
         # drop the leading collection name ("params")
@@ -202,21 +261,34 @@ class DModule:
         out_shardings (reference materialize_dtensor semantics)."""
         abstract = jax.eval_shape(lambda r: self.module.init(r, *args, **kwargs), rngs)
         shardings = self.variables_shardings(abstract)
+        if self.param_plan:
+            self._warn_unmatched(self.param_plan, self._param_matched, "parameter")
         init_fn = jax.jit(
             lambda r: self.module.init(r, *args, **kwargs), out_shardings=shardings
         )
         return init_fn(rngs)
 
     # ------------------------------------------------------------ apply
+    def _match_fwd(self, fqn: str, method_name: str):
+        """Fwd-plan lookup: bare ``fqn`` keys bind ``__call__`` (the
+        reference hooks wrap forward); ``fqn:method`` keys bind any other
+        intercepted method (e.g. ``emb:attend`` for a tied head)."""
+        for pattern, v in self.fwd_plan.items():
+            pat_fqn, _, pat_method = pattern.rpartition(":")
+            if not pat_fqn:
+                pat_fqn, pat_method = pat_method, "__call__"
+            if pat_method == method_name and re.fullmatch(pat_fqn, fqn):
+                self._fwd_matched.add(pattern)
+                return v
+        return None
+
     def _interceptor(self, next_fun, args, kwargs, context):
-        if context.method_name != "__call__":
-            return next_fun(*args, **kwargs)
         fqn = ".".join(context.module.path)
-        pi = _match(self.fwd_plan, fqn)
+        pi = self._match_fwd(fqn, context.method_name)
         if pi is None:
             return next_fun(*args, **kwargs)
         if pi.input is not None:
-            args = tuple(_constrain_tree(list(args), pi.input, self.mesh))
+            args, kwargs = _constrain_inputs(args, kwargs, pi.input, self.mesh)
         out = next_fun(*args, **kwargs)
         if pi.output is not None:
             out = _constrain_tree(out, pi.output, self.mesh)
@@ -224,7 +296,9 @@ class DModule:
 
     def apply(self, variables, *args, **kwargs):
         with nn.intercept_methods(self._interceptor):
-            return self.module.apply(variables, *args, **kwargs)
+            out = self.module.apply(variables, *args, **kwargs)
+        self._warn_unmatched_fwd_once()
+        return out
 
     def __call__(self, variables, *args, **kwargs):
         return self.apply(variables, *args, **kwargs)
@@ -234,6 +308,11 @@ def parallelize_module(
     module: nn.Module,
     device_mesh: DeviceMesh,
     sharding_plan: Optional[Dict[str, Any]] = None,
+    validate_plan: bool = True,
 ) -> DModule:
-    """Reference dmodule/api.py:33 — wrap a module with a sharding plan."""
-    return DModule(module, device_mesh, sharding_plan or {})
+    """Reference dmodule/api.py:33 — wrap a module with a sharding plan.
+
+    ``validate_plan=False`` silences the matched-nothing warnings — for
+    intentionally applying a whole-model plan to one sub-module (e.g. the
+    compiled pipeline parallelizes embed/block/head separately)."""
+    return DModule(module, device_mesh, sharding_plan or {}, validate_plan=validate_plan)
