@@ -170,9 +170,9 @@ def test_fsdp_plan_helper(mesh2d):
     plan = fsdp_plan(params, mesh2d, dim="dp")
     from vescale_tpu.dmodule.api import _match
 
-    w_pl = _match(plan, "w")
+    _, w_pl = _match(plan, "w")
     assert w_pl[0] == Shard(0)  # dp dim index 0, dim0 size 8 divisible by 2
-    tiny_pl = _match(plan, "tiny")
+    _, tiny_pl = _match(plan, "tiny")
     assert tiny_pl[0].is_replicate()
 
 
