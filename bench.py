@@ -33,6 +33,114 @@ def peak_flops_per_chip(device) -> float:
     return 1e12  # CPU fallback so the line still prints
 
 
+def time_and_report(step, params, opt_state, batch, *, n, tokens_per_step,
+                    flops_per_token, metric, on_tpu, extra=None):
+    """Warmup + timed loop + one JSON line (shared by every bench rung).
+    On the axon tunnel block_until_ready alone does not force execution, so
+    the loss is host-fetched for true timings."""
+    import jax
+
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, batch)
+        float(loss)
+    iters = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, batch)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    mfu = flops_per_token * tokens_per_step / dt / (peak_flops_per_chip(jax.devices()[0]) * n)
+    line = {
+        "metric": metric,
+        "value": round(mfu, 4),
+        "unit": "MFU",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "tokens_per_sec_per_chip": round(tokens_per_step / dt / n, 1),
+        "step_time_ms": round(dt * 1e3, 2),
+    }
+    line.update(extra or {})
+    print(json.dumps(line))
+    return mfu
+
+
+def bench_moe():
+    """Mixtral-style MoE/EP rung (BASELINE.md ladder: "Mixtral 8x7B EP"),
+    scaled to the available chips.  Run with VESCALE_BENCH=moe; the default
+    headline stays the llama rung the driver records."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.dmodule import parallelize_module
+    from vescale_tpu.models.mixtral import Mixtral, MixtralConfig, mixtral_plan
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
+    from vescale_tpu.train import make_train_step
+
+    devices = jax.devices()
+    n = len(devices)
+    on_tpu = devices[0].platform == "tpu"
+    if on_tpu:
+        B, T = 2, 2048
+        cfg = MixtralConfig(
+            vocab_size=32000,
+            hidden_size=768,
+            intermediate_size=1536,
+            num_hidden_layers=8,
+            num_attention_heads=12,
+            num_key_value_heads=4,
+            num_local_experts=8,
+            num_experts_per_tok=2,
+            capacity_factor=2.0,
+            max_position_embeddings=T,
+            dtype=jnp.bfloat16,
+        )
+        metric = "mixtral_moe_train_MFU_seq2048"
+    else:
+        B, T = 2, 64
+        cfg = MixtralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_local_experts=4, max_position_embeddings=T, dtype=jnp.float32,
+        )
+        metric = "mixtral_moe_cpu_smoke_MFU"
+
+    # keep dp >= 2 on multi-chip: mixtral_plan shards only the batch over dp,
+    # so maximizing ep would replicate all dense compute across ep ranks
+    ep = 1
+    max_ep = max(1, n // 2) if n > 1 else 1
+    for cand in range(min(max_ep, cfg.num_local_experts), 0, -1):
+        if n % cand == 0 and cfg.num_local_experts % cand == 0:
+            ep = cand
+            break
+    mesh = DeviceMesh(("dp", "ep"), (n // ep, ep), devices=devices)
+    dm = parallelize_module(Mixtral(cfg), mesh, mixtral_plan(mesh))
+    params = dm.init(jax.random.key(0), jnp.ones((2, T), jnp.int32))["params"]
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+    # router aux (load-balancing) loss intentionally excluded: it's sown into
+    # the "losses" collection and does not affect the compute profile
+    step = make_train_step(
+        dm, tx, lambda lg, b: cross_entropy_loss(lg, b["target"]), donate=True
+    )
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B * n, T + 1)), jnp.int32)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+    # active params per token: dense share + top_k/E of expert params
+    expert_params = 3 * cfg.num_local_experts * cfg.hidden_size * cfg.intermediate_size * cfg.num_hidden_layers
+    active = n_params - expert_params + expert_params * cfg.num_experts_per_tok / cfg.num_local_experts
+    time_and_report(
+        step, params, opt_state, batch,
+        n=n,
+        tokens_per_step=B * n * T,
+        flops_per_token=6.0 * active + 12.0 * cfg.num_hidden_layers * T * cfg.hidden_size,
+        metric=metric,
+        on_tpu=on_tpu,
+        extra={"params": n_params, "active_params": int(active), "seq_len": T, "ep": ep},
+    )
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -93,40 +201,27 @@ def main():
     toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B * n, T + 1)), jnp.int32)
     batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
 
-    for _ in range(3):
-        params, opt_state, loss = step(params, opt_state, batch)
-        float(loss)  # host fetch forces execution on the axon tunnel
-
-    iters = 10 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, loss = step(params, opt_state, batch)
-    float(loss)
-    dt = (time.perf_counter() - t0) / iters
-
-    tokens_per_step = B * n * T
-    tok_s_chip = tokens_per_step / dt / n
     # PaLM-style MFU: 6*P per token + attention 12*L*T*E per token (fwd+bwd)
-    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_hidden_layers * T * cfg.hidden_size
-    mfu = flops_per_token * tokens_per_step / dt / (peak_flops_per_chip(devices[0]) * n)
-
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(mfu, 4),
-                "unit": "MFU",
-                "vs_baseline": round(mfu / 0.45, 4),
-                "tokens_per_sec_per_chip": round(tok_s_chip, 1),
-                "step_time_ms": round(dt * 1e3, 2),
-                "params": n_params,
-                "seq_len": T,
-                # the kernel only actually runs on TPU (dense fallback off-TPU)
-                "flash_attention": bool(cfg.use_flash_attention and on_tpu),
-            }
-        )
+    time_and_report(
+        step, params, opt_state, batch,
+        n=n,
+        tokens_per_step=B * n * T,
+        flops_per_token=6.0 * n_params + 12.0 * cfg.num_hidden_layers * T * cfg.hidden_size,
+        metric=metric,
+        on_tpu=on_tpu,
+        extra={
+            "params": n_params,
+            "seq_len": T,
+            # the kernel only actually runs on TPU (dense fallback off-TPU)
+            "flash_attention": bool(cfg.use_flash_attention and on_tpu),
+        },
     )
 
 
 if __name__ == "__main__":
-    main()
+    import os
+
+    if os.environ.get("VESCALE_BENCH") == "moe":
+        bench_moe()
+    else:
+        main()

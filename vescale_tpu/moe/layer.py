@@ -97,4 +97,13 @@ class MoEMLP(nn.Module):
         ce = jnp.mean(jnp.max(expert_onehot, axis=1).astype(jnp.float32), axis=0)
         aux = c.aux_loss_coef * E * jnp.sum(me * ce)
 
+        # per-expert routed-token counts for load-aware expert allocation
+        # (reference MoEScheduler load stats -> BasicExpertsAllocator);
+        # collected non-invasively: apply(..., mutable=["intermediates"])
+        self.sow(
+            "intermediates",
+            "expert_tokens",
+            jnp.sum(jnp.max(expert_onehot, axis=1), axis=0).astype(jnp.float32),
+        )
+
         return y.reshape(orig_shape).astype(x.dtype), aux

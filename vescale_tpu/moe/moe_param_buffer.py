@@ -16,7 +16,7 @@ redistribute applied to each state leaf.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from ..darray import DArray, distribute_tensor
 from ..mesh import DeviceMesh
-from ..placements import RaggedShard, Replicate
+from ..placements import RaggedShard, Replicate, Shard, StridedRaggedShard
 from ..redistribute import redistribute
 from ..spec import DArraySpec, TensorMeta
 
@@ -33,12 +33,28 @@ __all__ = ["MoEParamBuffer"]
 
 class MoEParamBuffer:
     """Holds a pytree of expert params (every leaf leading dim == E) as
-    ragged DArrays over ``ep_dim`` with ``units`` experts per rank."""
+    ragged DArrays over ``ep_dim`` with ``units`` experts per rank.
 
-    def __init__(self, mesh: DeviceMesh, ep_dim: str, num_experts: int, units: Sequence[int]):
+    ``tp_dim`` (optional) gives every expert its own EP-rank x TP submesh —
+    the reference BasicExpertsAllocator's dynamic per-expert DP x TP
+    allocation (experts_allocator.py:63): each expert's flattened params are
+    further split evenly across ``tp_dim`` inside its ragged cell
+    (StridedRaggedShard composition, vescale/dtensor/placement_types.py:229).
+    """
+
+    def __init__(
+        self,
+        mesh: DeviceMesh,
+        ep_dim: str,
+        num_experts: int,
+        units: Sequence[int],
+        tp_dim: Optional[str] = None,
+    ):
         self.mesh = mesh
         self.ep_dim = ep_dim
         self.ep_index = mesh._dim_index(ep_dim)
+        self.tp_dim = tp_dim
+        self.tp_index = mesh._dim_index(tp_dim) if tp_dim is not None else None
         self.num_experts = num_experts
         self.units = tuple(int(u) for u in units)
         if sum(self.units) != num_experts:
@@ -48,7 +64,13 @@ class MoEParamBuffer:
         per_expert = int(np.prod(leaf_shape[1:])) if len(leaf_shape) > 1 else 1
         units = tuple(u * per_expert for u in self.units)
         placements = [Replicate()] * self.mesh.ndim
-        placements[self.ep_index] = RaggedShard(tuple(range(len(leaf_shape))), units)
+        dims = tuple(range(len(leaf_shape)))
+        if self.tp_index is None:
+            placements[self.ep_index] = RaggedShard(dims, units)
+        else:
+            s = self.mesh.shape[self.tp_index]
+            placements[self.ep_index] = StridedRaggedShard(dims, units, split_factor=s)
+            placements[self.tp_index] = Shard(0)
         return placements
 
     # ----------------------------------------------------------- pack/own
@@ -81,7 +103,7 @@ class MoEParamBuffer:
         refresh_buffer, _moe_param_buffer.py:183): ragged->ragged
         redistribute (all-to-all-v) on every leaf.  Apply to optimizer state
         trees too (MoEOptimizer.refresh)."""
-        new_buf = MoEParamBuffer(self.mesh, self.ep_dim, self.num_experts, new_units)
+        new_buf = MoEParamBuffer(self.mesh, self.ep_dim, self.num_experts, new_units, tp_dim=self.tp_dim)
 
         def one(d: DArray):
             return redistribute(d, new_buf._placement(d.shape))
