@@ -1,0 +1,278 @@
+"""Round-2 test-depth battery (VERDICT r1 next #10): bitwise RNG under
+resharding, uneven shards inside jit, bf16 tolerance tiers, error paths, and
+planted-bug sensitivity checks proving the parity tests have teeth.
+
+Mirrors the reference's deepest test ideas: single-device-equal RNG
+(legacy/test/dtensor/ops/test_random_ops.py), negative-path validation, and
+bitwise accuracy alignment (test_pp_accuracy_alignment.py)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+import flax.linen as nn
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import vescale_tpu as vt
+from vescale_tpu.darray import from_local, randn
+from vescale_tpu.dmodule import parallelize_module
+from vescale_tpu.models.nanogpt import GPT, GPTConfig, cross_entropy_loss, nanogpt_plan
+from vescale_tpu.placements import Partial, RaggedShard, Replicate, Shard
+
+
+# ----------------------------------------------------- RNG under resharding
+def test_rng_bitwise_across_mesh_shapes():
+    """The same seed produces BITWISE-identical logical values no matter the
+    mesh shape or placement — the property the reference needed a patched
+    CUDA philox for (random.py:340 ThreadBasedRNGTracker)."""
+    key = jax.random.key(42)
+    golden = None
+    layouts = [
+        (vt.DeviceMesh(("x",), (8,)), [Shard(0)]),
+        (vt.DeviceMesh(("x",), (8,)), [Shard(1)]),
+        (vt.DeviceMesh(("a", "b"), (2, 4)), [Shard(0), Shard(1)]),
+        (vt.DeviceMesh(("a", "b"), (4, 2)), [Replicate(), Shard(0)]),
+        (vt.DeviceMesh(("a", "b"), (2, 4)), [Replicate(), Replicate()]),
+    ]
+    for mesh, pl in layouts:
+        d = randn(16, 8, device_mesh=mesh, placements=pl, key=key)
+        full = np.asarray(d.full_tensor())
+        if golden is None:
+            golden = full
+        else:
+            np.testing.assert_array_equal(full, golden)
+
+
+def test_dropout_bitwise_sharded_vs_single():
+    """Dropout masks inside jit are bitwise-equal between a sharded and an
+    unsharded execution (threefry partitionable — the distributed-dropout
+    bitwise claim of the reference nanoGPT example)."""
+    mesh = vt.DeviceMesh(("dp", "tp"), (2, 4))
+    x = jax.random.normal(jax.random.key(0), (8, 32))
+
+    def drop(x, key):
+        mask = jax.random.bernoulli(key, 0.8, x.shape)
+        return jnp.where(mask, x / 0.8, 0.0)
+
+    key = jax.random.key(7)
+    ref = jax.jit(drop)(x, key)
+    xs = jax.device_put(x, NamedSharding(mesh.jax_mesh, P("dp", "tp")))
+    out = jax.jit(drop)(xs, key)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_rng_bitwise_after_redistribute():
+    """Drawing on one layout then resharding == drawing on the target layout
+    directly (bitwise)."""
+    mesh = vt.DeviceMesh(("dp", "tp"), (2, 4))
+    key = jax.random.key(3)
+    a = randn(12, 6, device_mesh=mesh, placements=[Shard(0), Replicate()], key=key)
+    b = vt.redistribute(a, [Replicate(), Shard(1)])
+    c = randn(12, 6, device_mesh=mesh, placements=[Replicate(), Shard(1)], key=key)
+    np.testing.assert_array_equal(np.asarray(b.full_tensor()), np.asarray(c.full_tensor()))
+    for r in range(8):
+        np.testing.assert_array_equal(np.asarray(b.to_local(r)), np.asarray(c.to_local(r)))
+
+
+# ------------------------------------------------------ uneven shards in jit
+def test_uneven_batch_and_seq_inside_jit(mesh2d):
+    """Batch/seq sizes NOT divisible by the mesh dims run correctly under
+    jit with the full TP/SP plan (GSPMD pads internally)."""
+    cfg = GPTConfig(block_size=16, vocab_size=64, n_layer=2, n_head=2, n_embd=32, dropout=0.0)
+    dm = parallelize_module(GPT(cfg), mesh2d, nanogpt_plan(mesh2d))
+    v = dm.init(jax.random.key(0), jnp.ones((2, 16), jnp.int32))
+    for B, T in ((6, 16), (3, 10), (5, 7)):
+        x = jax.random.randint(jax.random.key(B * T), (B, T), 0, 64)
+        out = jax.jit(lambda v, x: dm.apply(v, x))(v, x)
+        ref = GPT(cfg).apply(v, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_uneven_redistribute_inside_jit():
+    """Eager-API redistribute of uneven shards composes under jit."""
+    mesh = vt.DeviceMesh(("x",), (8,))
+    x = jnp.arange(13 * 5.0).reshape(13, 5)
+    d = vt.distribute_tensor(x, mesh, [Shard(0)])
+
+    @jax.jit
+    def go(d):
+        r = vt.redistribute(d, [Shard(1)])
+        return r.data
+
+    out = go(d)
+    r = vt.redistribute(d, [Shard(1)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(r.data), rtol=1e-6)
+
+
+# ------------------------------------------------------- bf16 tolerance tier
+@pytest.mark.parametrize(
+    "dtype,rtol",
+    [(jnp.float32, 5e-5), (jnp.bfloat16, 1.5e-2)],
+    ids=["fp32", "bf16"],
+)
+def test_tp_sp_loss_parity_tiered(mesh2d, dtype, rtol):
+    """Golden-parity at both precisions with tiered tolerances (reference
+    bar: negligible fp32, ~1% bf16 — nanogpt_4D_finetune/README.md:38)."""
+    cfg = GPTConfig(
+        block_size=16, vocab_size=64, n_layer=2, n_head=2, n_embd=32, dropout=0.0, dtype=dtype
+    )
+    model = GPT(cfg)
+    dm = parallelize_module(model, mesh2d, nanogpt_plan(mesh2d))
+    v = dm.init(jax.random.key(0), jnp.ones((2, 16), jnp.int32))
+    toks = jax.random.randint(jax.random.key(1), (8, 17), 0, 64)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+
+    tx = optax.adamw(1e-3)
+
+    def run(apply_fn):
+        params, opt = v["params"], tx.init(v["params"])
+        losses = []
+        for _ in range(3):
+            loss, g = jax.jit(
+                jax.value_and_grad(
+                    lambda p: cross_entropy_loss(apply_fn({"params": p}, batch["input"]), batch["target"])
+                )
+            )(params)
+            upd, opt = tx.update(g, opt, params)
+            params = optax.apply_updates(params, upd)
+            losses.append(float(loss))
+        return losses
+
+    sharded = run(dm.apply)
+    single = run(model.apply)
+    np.testing.assert_allclose(sharded, single, rtol=rtol)
+
+
+# ------------------------------------------------------------- error paths
+def test_error_paths_raise_informatively():
+    mesh = vt.DeviceMesh(("dp", "tp"), (2, 4))
+    # from_local with the wrong number of locals
+    with pytest.raises(ValueError, match="need 8 locals"):
+        from_local([np.ones((2, 2))] * 3, mesh, [Shard(0), Replicate()])
+    # ragged local size mismatch
+    m1 = vt.DeviceMesh(("x",), (4,))
+    with pytest.raises(ValueError, match="ragged local size"):
+        from_local(
+            [np.ones(5), np.ones(5), np.ones(5), np.ones(5)],
+            m1,
+            [RaggedShard((0,), (1, 2, 2, 1))],
+            shape=(24,),
+        )
+    # pipeline: batch not divisible by microbatches
+    from vescale_tpu.pipe.spmd import pipeline_blocks, stack_stage_params
+
+    mesh_pp = vt.DeviceMesh(("pp", "dp"), (4, 2))
+    blk_params = [{"w": jnp.ones((2, 2))} for _ in range(4)]
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_blocks(
+            lambda p, x: x, stack_stage_params(blk_params), jnp.ones((6, 2, 2)), mesh_pp,
+            num_microbatches=4,
+        )
+    # pipeline: mis-stacked leading axis
+    with pytest.raises(ValueError, match="leading axis"):
+        pipeline_blocks(
+            lambda p, x: x, stack_stage_params(blk_params), jnp.ones((8, 2, 2)), mesh_pp,
+            num_microbatches=4, virtual_chunks=2,
+        )
+    # MoE buffer: units don't sum to num_experts
+    from vescale_tpu.moe import MoEParamBuffer
+
+    with pytest.raises(ValueError, match="units"):
+        MoEParamBuffer(m1, "x", 8, (1, 2, 2, 1))
+    # redistribute single local for a sharded source
+    from vescale_tpu.redistribute import redistribute_local_tensor
+    from vescale_tpu.spec import DArraySpec, TensorMeta
+
+    src = DArraySpec(m1, [Shard(0)], TensorMeta((8, 2), jnp.dtype(jnp.float32)))
+    dst = DArraySpec(m1, [Replicate()], TensorMeta((8, 2), jnp.dtype(jnp.float32)))
+    with pytest.raises(ValueError, match="replicated"):
+        redistribute_local_tensor(np.ones((2, 2), np.float32), src, dst)
+
+
+def test_loss_parallel_warns_noop():
+    """VERDICT r1 weak #9: loss_parallel() must not silently no-op."""
+    from vescale_tpu import loss as loss_mod
+
+    loss_mod.loss_parallel._warned = False
+    with pytest.warns(UserWarning, match="no dispatch interception"):
+        with loss_mod.loss_parallel():
+            pass
+
+
+# -------------------------------------------------------- planted-bug teeth
+def test_planted_bug_vpp_wrong_stacking_detected():
+    """Deliberately mis-stacked VPP params (chunk-major instead of
+    stage-major) produce detectably WRONG outputs — the parity test would
+    catch the layout bug."""
+    from vescale_tpu.pipe.spmd import pipeline_blocks, stack_interleaved_params, stack_stage_params
+
+    S, V = 4, 2
+    mesh = vt.DeviceMesh(("pp", "dp"), (S, 2))
+
+    class Blk(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return x + nn.Dense(x.shape[-1])(nn.tanh(x))
+
+    blk = Blk()
+    x = jax.random.normal(jax.random.key(0), (8, 4, 16))
+    plist = [blk.init(k, x)["params"] for k in jax.random.split(jax.random.key(1), S * V)]
+    bf = lambda p, xm: blk.apply({"params": p}, xm)
+
+    def seq(pl, xx):
+        for p in pl:
+            xx = blk.apply({"params": p}, xx)
+        return xx
+
+    golden = seq(plist, x)
+    run = jax.jit(
+        lambda stacked, x: pipeline_blocks(
+            bf, stacked, x, mesh, num_microbatches=4, virtual_chunks=V
+        )
+    )
+    right = run(stack_interleaved_params(plist, S), x)
+    np.testing.assert_allclose(np.asarray(right), np.asarray(golden), rtol=2e-4, atol=2e-4)
+    # planted bug: naive chunk-major stacking
+    wrong = run(stack_stage_params(plist), x)
+    assert not np.allclose(np.asarray(wrong), np.asarray(golden), rtol=2e-4, atol=2e-4)
+
+
+def test_planted_bug_wrong_ragged_units_detected():
+    """Lying about ragged units misplaces data in a way the round-trip
+    check catches (to_local returns the wrong slice)."""
+    m1 = vt.DeviceMesh(("x",), (4,))
+    xr = jnp.arange(24.0)
+    d = vt.distribute_tensor(xr, m1, [RaggedShard((0,), (1, 2, 2, 1))])
+    ok = d.to_local(1)
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(xr[4:12]))
+    d_bug = vt.distribute_tensor(xr, m1, [RaggedShard((0,), (2, 1, 2, 1))])
+    assert not np.array_equal(np.asarray(d_bug.to_local(1)), np.asarray(xr[4:12]))
+
+
+def test_planted_bug_partial_mislabel_detected():
+    """Labeling genuinely-partial operands as Replicate yields a wrong
+    full_tensor — the Partial placement is semantically load-bearing."""
+    m1 = vt.DeviceMesh(("x",), (4,))
+    locals_ = [np.full((2, 2), float(r + 1), np.float32) for r in range(4)]
+    right = from_local(list(locals_), m1, [Partial()])
+    np.testing.assert_allclose(np.asarray(right.full_tensor()), np.full((2, 2), 10.0))
+    wrong = from_local(list(locals_), m1, [Replicate()])
+    assert not np.allclose(np.asarray(wrong.full_tensor()), np.full((2, 2), 10.0))
+
+
+def test_vocab_parallel_loss_grad_parity():
+    """The explicit shard_map vocab-parallel loss is differentiable (the
+    stabilizing pmax shift is stop-gradiented) and its grads match the dense
+    path — it must be usable as a TRAINING loss (reference
+    _VocabParallelCrossEntropy backward, vp_cross_entropy.py:149)."""
+    from vescale_tpu.loss import vocab_parallel_cross_entropy
+
+    mesh = vt.DeviceMesh(("dp", "tp"), (2, 4))
+    logits = jax.random.normal(jax.random.key(2), (4, 8, 64))
+    targets = jax.random.randint(jax.random.key(3), (4, 8), 0, 64)
+    g_sharded = jax.jit(
+        jax.grad(lambda lg: vocab_parallel_cross_entropy(lg, targets, mesh=mesh, vocab_dim_name="tp"))
+    )(logits)
+    g_dense = jax.grad(lambda lg: vocab_parallel_cross_entropy(lg, targets))(logits)
+    np.testing.assert_allclose(np.asarray(g_sharded), np.asarray(g_dense), rtol=2e-5, atol=2e-6)

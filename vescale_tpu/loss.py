@@ -16,6 +16,7 @@ The `loss_parallel()` context manager is kept for migration parity.
 from __future__ import annotations
 
 import contextlib
+import functools
 from typing import Optional
 
 import jax
@@ -31,8 +32,22 @@ __all__ = ["loss_parallel", "vocab_parallel_cross_entropy"]
 @contextlib.contextmanager
 def loss_parallel():
     """Reference ctx manager (loss.py:39).  On TPU the efficient sharded
-    loss needs no dispatch interception — this simply scopes intent (and
-    keeps migrated code importable)."""
+    loss needs no dispatch interception — under jit, GSPMD partitions the
+    softmax/NLL reductions over whatever sharding the logits carry, so this
+    scopes intent only (and keeps migrated code importable).  It warns once
+    so users expecting the reference's op-interception semantics know to
+    call ``vocab_parallel_cross_entropy`` for the explicit shard_map path."""
+    import warnings
+
+    if not getattr(loss_parallel, "_warned", False):
+        loss_parallel._warned = True
+        warnings.warn(
+            "loss_parallel() performs no dispatch interception on TPU: inside "
+            "jit the sharded loss is already efficient via GSPMD; for the "
+            "explicit no-full-logits path use vocab_parallel_cross_entropy("
+            "..., mesh=, vocab_dim_name=)",
+            stacklevel=3,
+        )
     yield
 
 
@@ -62,8 +77,18 @@ def vocab_parallel_cross_entropy(
             return jnp.mean(logz - (1 - label_smoothing) * gold - label_smoothing * jnp.mean(lg, axis=-1))
         return jnp.mean(logz - gold)
 
-    ax = mesh.dim_name(vocab_dim_name)
-    n = mesh.size(vocab_dim_name)
+    # the builder returns a jit-wrapped fn cached per (mesh, axis, vocab,
+    # smoothing, rank): eager calls reuse one compilation, traced calls
+    # inline it into the enclosing jit
+    fn = _vocab_parallel_fn(
+        mesh, mesh.dim_name(vocab_dim_name), V, float(label_smoothing), logits.ndim
+    )
+    return fn(logits, targets)
+
+
+@functools.lru_cache(maxsize=64)
+def _vocab_parallel_fn(mesh: DeviceMesh, ax: str, V: int, label_smoothing: float, ndim: int):
+    n = mesh.size(ax)
     shard_v = V // n
 
     def body(lg_local, tgt):
@@ -71,9 +96,11 @@ def vocab_parallel_cross_entropy(
         lg_local = lg_local.astype(jnp.float32)
         r = jax.lax.axis_index(ax)
         lo = r * shard_v
-        # numerically-stable logsumexp across shards: global max first
+        # numerically-stable logsumexp across shards: global max first.
+        # stop_gradient: the max-shift cancels exactly in the gradient, and
+        # pmax has no differentiation rule
         local_max = jnp.max(lg_local, axis=-1)
-        gmax = jax.lax.pmax(local_max, ax)
+        gmax = jax.lax.stop_gradient(jax.lax.pmax(jax.lax.stop_gradient(local_max), ax))
         sumexp = jnp.sum(jnp.exp(lg_local - gmax[..., None]), axis=-1)
         gsum = jax.lax.psum(sumexp, ax)
         logz = gmax + jnp.log(gsum)
@@ -87,12 +114,13 @@ def vocab_parallel_cross_entropy(
             return jnp.mean(logz - (1 - label_smoothing) * gold - label_smoothing * mean_v)
         return jnp.mean(logz - gold)
 
-    fn = shard_map(
-        body,
-        mesh=mesh.jax_mesh,
-        in_specs=(P(*([None] * (logits.ndim - 1) + [ax])), P()),
-        out_specs=P(),
-        check_vma=False,
-        axis_names=frozenset({ax}),
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh.jax_mesh,
+            in_specs=(P(*([None] * (ndim - 1) + [ax])), P()),
+            out_specs=P(),
+            check_vma=False,
+            axis_names=frozenset({ax}),
+        )
     )
-    return fn(logits, targets)

@@ -94,16 +94,13 @@ class MoEMLP(nn.Module):
         # tokens whose top-k includes the expert
         expert_onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # (N,K,E)
         me = jnp.mean(probs, axis=0)  # (E,)
-        ce = jnp.mean(jnp.max(expert_onehot, axis=1).astype(jnp.float32), axis=0)
+        routed = jnp.max(expert_onehot, axis=1).astype(jnp.float32)  # (N,E)
+        ce = jnp.mean(routed, axis=0)
         aux = c.aux_loss_coef * E * jnp.sum(me * ce)
 
         # per-expert routed-token counts for load-aware expert allocation
         # (reference MoEScheduler load stats -> BasicExpertsAllocator);
         # collected non-invasively: apply(..., mutable=["intermediates"])
-        self.sow(
-            "intermediates",
-            "expert_tokens",
-            jnp.sum(jnp.max(expert_onehot, axis=1), axis=0).astype(jnp.float32),
-        )
+        self.sow("intermediates", "expert_tokens", jnp.sum(routed, axis=0))
 
         return y.reshape(orig_shape).astype(x.dtype), aux
