@@ -125,3 +125,114 @@ def test_flash_partitioned_seq_sharded_input_gathers():
     out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=True))(qs, ks_, vs)
     golden = _dense_ref(q, k, v, 1.0 / np.sqrt(D), True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("rep", [2, 4])
+def test_flash_gqa_matches_dense(causal, rep):
+    """GQA: kv heads stay un-repeated in HBM; kernel output must equal the
+    dense reference computed on repeated heads."""
+    B, T, H, D = 2, 128, 8, 32
+    G = H // rep
+    ks = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, G, D))
+    v = jax.random.normal(ks[2], (B, T, G, D))
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64, interpret=True)
+    golden = _dense_ref(q, k, v, 1.0 / np.sqrt(D), causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_gqa_grads_match_dense():
+    """dk/dv must sum over the group's q heads (the accumulation grid dim)."""
+    B, T, H, D = 1, 64, 4, 16
+    G = 2
+    ks = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, G, D))
+    v = jax.random.normal(ks[2], (B, T, G, D))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=32, block_k=32, interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_ref(q, k, v, 1.0 / np.sqrt(D), True) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_flash_gqa_bad_heads_raises():
+    q = jnp.ones((1, 64, 6, 16))
+    kv = jnp.ones((1, 64, 4, 16))
+    with pytest.raises(ValueError, match="not a multiple"):
+        flash_attention(q, kv, kv, interpret=True)
+
+
+def test_flash_gqa_gspmd_partitionable():
+    """GQA under a dp x tp mesh with plain jit: tp shards q heads AND the
+    smaller kv-head dim (tp | KV); fwd + bwd match dense with no shard_map."""
+    import vescale_tpu as vt
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = vt.DeviceMesh(("dp", "tp"), (2, 2))
+    B, T, H, D = 4, 128, 8, 16
+    G = 4
+    ks = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, G, D))
+    v = jax.random.normal(ks[2], (B, T, G, D))
+    sh = NamedSharding(mesh.jax_mesh, P("dp", None, "tp", None))
+    qs, ks_, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=True))
+    out = f(qs, ks_, vs)
+    golden = _dense_ref(q, k, v, 1.0 / np.sqrt(D), True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)
+
+    g = jax.jit(
+        jax.grad(
+            lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=True).sum(),
+            argnums=(0, 1, 2),
+        )
+    )(qs, ks_, vs)
+    gref = jax.grad(
+        lambda q, k, v: _dense_ref(q, k, v, 1.0 / np.sqrt(D), True).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(g, gref):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_flash_mqa_tp_falls_back_to_batch_partitioning():
+    """MQA (G=1) with q heads tp-sharded: tp does not divide G, so the
+    partition rule must drop the head axis (replicate) instead of splitting
+    the size-1 kv-head dim — output still matches dense."""
+    import vescale_tpu as vt
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = vt.DeviceMesh(("dp", "tp"), (2, 4))
+    B, T, H, D = 2, 128, 8, 16
+    ks = jax.random.split(jax.random.key(6), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, 1, D))  # MQA
+    v = jax.random.normal(ks[2], (B, T, 1, D))
+    qs = jax.device_put(q, NamedSharding(mesh.jax_mesh, P("dp", None, "tp", None)))
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=True))(qs, k, v)
+    golden = _dense_ref(q, k, v, 1.0 / np.sqrt(D), True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)
+
+    g = jax.jit(
+        jax.grad(
+            lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=True).sum(),
+            argnums=(1, 2),
+        )
+    )(qs, k, v)
+    gref = jax.grad(
+        lambda q, k, v: _dense_ref(q, k, v, 1.0 / np.sqrt(D), True).sum(), argnums=(1, 2)
+    )(q, k, v)
+    for a, b in zip(g, gref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)

@@ -124,3 +124,66 @@ def test_mixtral_trains_with_aux_loss():
         params, opt, l = step(params, opt, batch)
         losses.append(float(l))
     assert losses[-1] < losses[0]  # overfits one batch
+
+
+def test_llama_scan_layers_matches_loop():
+    """scan_layers=True computes the same function: stack the loop model's
+    per-layer params into the scanned layout and compare logits."""
+    import dataclasses
+
+    loop_cfg = TINY_LLAMA
+    scan_cfg = dataclasses.replace(TINY_LLAMA, scan_layers=True)
+    idx = jnp.ones((2, 16), jnp.int32)
+    loop_params = Llama(loop_cfg).init(jax.random.key(0), idx)["params"]
+
+    per_layer = [loop_params[f"layers_{i}"] for i in range(loop_cfg.num_hidden_layers)]
+    stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *per_layer)
+    scan_params = {
+        k: v for k, v in loop_params.items() if not k.startswith("layers_")
+    }
+    scan_params["layers"] = {"block": stacked}
+
+    out_loop = Llama(loop_cfg).apply({"params": loop_params}, idx)
+    out_scan = Llama(scan_cfg).apply({"params": scan_params}, idx)
+    np.testing.assert_allclose(np.asarray(out_scan), np.asarray(out_loop), rtol=1e-5, atol=1e-5)
+
+    # remat composes with scan
+    remat_cfg = dataclasses.replace(scan_cfg, remat=True)
+    out_remat = Llama(remat_cfg).apply({"params": scan_params}, idx)
+    np.testing.assert_allclose(np.asarray(out_remat), np.asarray(out_scan), rtol=1e-6)
+
+
+def test_llama_scanned_plan_shards_stack(mesh2d):
+    """llama_plan(scanned=True) shifts block tp-shards past the (L,) stack
+    axis; parallelize_module on the scanned model lands tp on the right dim."""
+    import dataclasses
+    from jax.sharding import PartitionSpec as P
+
+    cfg = dataclasses.replace(TINY_LLAMA, scan_layers=True)
+    dm = parallelize_module(
+        Llama(cfg), mesh2d, llama_plan(mesh2d, sequence_parallel=False, scanned=True)
+    )
+    params = dm.init(jax.random.key(0), jnp.ones((2, 16), jnp.int32))["params"]
+    blk = params["layers"]["block"]
+    L = cfg.num_hidden_layers
+    def norm(spec, ndim):
+        return tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+
+    q = blk["self_attn"]["q_proj"]["kernel"]
+    assert q.shape[0] == L
+    assert norm(q.sharding.spec, 3) == (None, None, "tp")  # col: stacked (L, in, out/tp)
+    o = blk["self_attn"]["o_proj"]["kernel"]
+    assert norm(o.sharding.spec, 3) == (None, "tp", None)  # row: (L, in/tp, out)
+    emb = params["embed_tokens"]["embedding"]
+    assert norm(emb.sharding.spec, 2) == (None, "tp")      # unstacked keeps dims
+    # scanned model trains under the plan
+    toks = jnp.ones((4, 17), jnp.int32)
+    out = dm.apply({"params": params}, toks[:, :-1])
+    assert out.shape == (4, 16, cfg.vocab_size)
+
+
+def test_llama_remat_policy_without_remat_raises():
+    import dataclasses
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        dataclasses.replace(TINY_LLAMA, remat_policy="dots_saveable")

@@ -1,0 +1,147 @@
+"""Multi-process ndtimeline streaming (reference sock_streamer.py): ranks
+flush spans over a socket to a collector that aggregates across ranks."""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from vescale_tpu.ndtimeline import (
+    ChromeTraceHandler,
+    NDTimerManager,
+    NDtimelineStreamer,
+    SockHandler,
+)
+
+
+def _wait_until(pred, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_two_ranks_stream_to_collector(tmp_path):
+    addr = str(tmp_path / "ndt.sock")
+    got = []
+    streamer = NDtimelineStreamer.start(addr, handlers=[got.extend])
+    try:
+        mgrs = [NDTimerManager(rank=r) for r in (0, 1)]
+        senders = [SockHandler(addr) for _ in mgrs]
+        for m, s in zip(mgrs, senders):
+            m.register_handler(s)
+            with m.timeit("fwd"):
+                time.sleep(0.01)
+            m.flush()
+        assert _wait_until(lambda: len(got) >= 2)
+        assert {s.rank for s in got} == {0, 1}
+        assert all(s.metric == "fwd" and s.duration > 0 for s in got)
+        assert all(sd.dropped == 0 for sd in senders)
+    finally:
+        streamer.stop()
+
+
+def test_collector_feeds_chrome_trace(tmp_path):
+    addr = str(tmp_path / "ndt2.sock")
+    chrome = ChromeTraceHandler(str(tmp_path / "trace.json"))
+    streamer = NDtimelineStreamer.start(addr, handlers=[chrome])
+    try:
+        mgr = NDTimerManager(rank=3)
+        mgr.register_handler(SockHandler(addr))
+        with mgr.timeit("step", tags={"mb": 1}):
+            pass
+        mgr.flush()
+        assert _wait_until(lambda: streamer.received >= 1)
+        path = chrome.write()
+        events = json.load(open(path))["traceEvents"]
+        assert events and events[0]["pid"] == 3 and events[0]["name"] == "step"
+    finally:
+        streamer.stop()
+
+
+def test_sender_survives_missing_collector(tmp_path):
+    """Profiling must never take down training: flush with no collector
+    drops the batch and counts it."""
+    mgr = NDTimerManager(rank=0)
+    sender = SockHandler(str(tmp_path / "nobody.sock"))
+    mgr.register_handler(sender)
+    with mgr.timeit("fwd"):
+        pass
+    mgr.flush()  # no raise
+    assert sender.dropped == 1
+
+
+def test_real_subprocess_sender(tmp_path):
+    """A genuinely separate process streams its spans in (the reference's
+    per-rank worker shape)."""
+    addr = str(tmp_path / "ndt3.sock")
+    got = []
+    streamer = NDtimelineStreamer.start(addr, handlers=[got.extend])
+    code = f"""
+import time
+from vescale_tpu.ndtimeline import NDTimerManager, SockHandler
+mgr = NDTimerManager(rank=7)
+mgr.register_handler(SockHandler({addr!r}))
+with mgr.timeit("child-span"):
+    time.sleep(0.005)
+mgr.flush()
+"""
+    try:
+        subprocess.run(
+            [sys.executable, "-c", code], check=True, timeout=120, cwd=".",
+            env={"PYTHONPATH": ".", "PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                 "HOME": "/root"},
+        )
+        assert _wait_until(lambda: len(got) >= 1)
+        assert got[0].rank == 7 and got[0].metric == "child-span"
+    finally:
+        streamer.stop()
+
+
+def test_sender_serializes_numpy_tags(tmp_path):
+    """Non-JSON-native tag values (numpy scalars) must not crash the flush."""
+    import numpy as np
+
+    addr = str(tmp_path / "ndt4.sock")
+    got = []
+    streamer = NDtimelineStreamer.start(addr, handlers=[got.extend])
+    try:
+        mgr = NDTimerManager(rank=0)
+        sender = SockHandler(addr)
+        mgr.register_handler(sender)
+        with mgr.timeit("step", tags={"lr": np.float32(3e-4)}):
+            pass
+        mgr.flush()  # no raise
+        assert _wait_until(lambda: len(got) >= 1)
+        assert sender.dropped == 0
+    finally:
+        streamer.stop()
+
+
+def test_collector_survives_malformed_frame(tmp_path):
+    """A garbage payload drops that connection (counted), not the collector."""
+    import socket
+    import struct
+
+    addr = str(tmp_path / "ndt5.sock")
+    got = []
+    streamer = NDtimelineStreamer.start(addr, handlers=[got.extend])
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(addr)
+        s.sendall(struct.pack(">I", 7) + b"garbage")
+        s.close()
+        assert _wait_until(lambda: streamer.decode_errors >= 1)
+        # a healthy sender still works afterwards
+        mgr = NDTimerManager(rank=1)
+        mgr.register_handler(SockHandler(addr))
+        with mgr.timeit("ok"):
+            pass
+        mgr.flush()
+        assert _wait_until(lambda: len(got) >= 1)
+    finally:
+        streamer.stop()

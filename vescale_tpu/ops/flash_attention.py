@@ -75,8 +75,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, 
     lse_ref[0] = (m + jnp.log(l_safe))[:, None]
 
 
-def _flash_fwd_pallas(q3, k3, v3, scale, causal, block_q, block_k, interpret):
+def _flash_fwd_pallas(q3, k3, v3, scale, causal, block_q, block_k, interpret, H, KV):
+    """q3: (B*H, T, D); k3/v3: (B*KV, T, D) — GQA never materializes the
+    repeated K/V heads; the BlockSpec index map routes each q head to its
+    kv group (rows are consecutive per group, llama repeat convention)."""
     BH, T, D = q3.shape
+    rep = H // KV
+    kv_row = lambda b, i: ((b // H) * KV + (b % H) // rep, 0, 0)
     grid = (BH, T // block_q)
     return pl.pallas_call(
         functools.partial(
@@ -89,8 +94,8 @@ def _flash_fwd_pallas(q3, k3, v3, scale, causal, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), kv_row),
+            pl.BlockSpec((1, T, D), kv_row),
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
@@ -132,8 +137,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_len):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_len, rep):
+    """Grid (B*KV, T//block_k, rep): the last (fastest) grid dim walks the
+    ``rep`` q heads of this kv group, accumulating into the same dk/dv
+    block (TPU grids run sequentially, so output revisiting is the
+    accumulation pattern) — GQA head reduction without materializing
+    repeated K/V or an (rep, T, D) VMEM slab."""
     ki = pl.program_id(1)
+    r = pl.program_id(2)
     k = k_ref[0].astype(jnp.float32)  # (block_k, D)
     v = v_ref[0].astype(jnp.float32)
     D = k.shape[-1]
@@ -164,12 +175,28 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk, dv = jax.lax.fori_loop(
         first, nq_total, body, (jnp.zeros((block_k, D), jnp.float32), jnp.zeros((block_k, D), jnp.float32))
     )
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if rep == 1:
+        dk_ref[0] = dk.astype(dk_ref.dtype)
+        dv_ref[0] = dv.astype(dv_ref.dtype)
+    else:
+
+        # rep > 1 outputs are fp32 (cast happens outside the kernel): the
+        # cross-head accumulation must not round through bf16 each step
+        @pl.when(r == 0)
+        def _init():
+            dk_ref[0] = dk
+            dv_ref[0] = dv
+
+        @pl.when(r > 0)
+        def _acc():
+            dk_ref[0] = dk_ref[0] + dk
+            dv_ref[0] = dv_ref[0] + dv
 
 
-def _flash_bwd_pallas(q3, k3, v3, o3, do3, lse, scale, causal, block_q, block_k, interpret):
+def _flash_bwd_pallas(q3, k3, v3, o3, do3, lse, scale, causal, block_q, block_k, interpret, H, KV):
     BH, T, D = q3.shape
+    rep = H // KV
+    kv_row = lambda b, i: ((b // H) * KV + (b % H) // rep, 0, 0)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1, keepdims=True)  # (BH, T, 1)
     kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=T)
     dq = pl.pallas_call(
@@ -178,8 +205,8 @@ def _flash_bwd_pallas(q3, k3, v3, o3, do3, lse, scale, causal, block_q, block_k,
         grid=(BH, T // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), kv_row),
+            pl.BlockSpec((1, T, D), kv_row),
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
@@ -187,32 +214,41 @@ def _flash_bwd_pallas(q3, k3, v3, o3, do3, lse, scale, causal, block_q, block_k,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
+    # dk/dv: kv-centric grid; q rows of group g are the consecutive
+    # [g*rep, (g+1)*rep) band, walked by the last grid dim
+    q_row = lambda b, i, r: ((b // KV) * H + (b % KV) * rep + r, 0, 0)
+    kv_blk = lambda b, i, r: (b, i, 0)
+    acc_dtype = k3.dtype if rep == 1 else jnp.float32
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, **kw),
+        functools.partial(_dkv_kernel, rep=rep, **kw),
         out_shape=(
-            jax.ShapeDtypeStruct(k3.shape, k3.dtype),
-            jax.ShapeDtypeStruct(v3.shape, v3.dtype),
+            jax.ShapeDtypeStruct(k3.shape, acc_dtype),
+            jax.ShapeDtypeStruct(v3.shape, acc_dtype),
         ),
-        grid=(BH, T // block_k),
+        grid=(k3.shape[0], T // block_k, rep),
         in_specs=[
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), q_row),
+            pl.BlockSpec((1, block_k, D), kv_blk),
+            pl.BlockSpec((1, block_k, D), kv_blk),
+            pl.BlockSpec((1, T, D), q_row),
+            pl.BlockSpec((1, T, 1), q_row),
+            pl.BlockSpec((1, T, 1), q_row),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), kv_blk),
+            pl.BlockSpec((1, block_k, D), kv_blk),
         ),
         interpret=interpret,
     )(q3, k3, v3, do3, lse, delta)
-    return dq, dk, dv
+    return dq, dk.astype(k3.dtype), dv.astype(v3.dtype)
 
 
 # ---------------------------------------------------------------- reference
 def _dense_ref(q, k, v, scale, causal):
+    if k.shape[2] != q.shape[2]:  # GQA: repeat kv heads for the dense math
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         T = q.shape[1]
@@ -234,19 +270,24 @@ def _from3(x, B, H):
 
 
 def _fwd_4d(q, k, v, scale, causal, block_q, block_k, interpret):
-    """(B,T,H,D) q/k/v -> (o (B,T,H,D), lse (B,H,T)) via the pallas kernels."""
+    """(B,T,H,D) q + (B,T,G,D) k/v (G | H; GQA stays un-repeated) ->
+    (o (B,T,H,D), lse (B,H,T)) via the pallas kernels."""
     B, T, H, D = q.shape
-    o3, lse3 = _flash_fwd_pallas(_to3(q), _to3(k), _to3(v), scale, causal, block_q, block_k, interpret)
+    G = k.shape[2]
+    o3, lse3 = _flash_fwd_pallas(
+        _to3(q), _to3(k), _to3(v), scale, causal, block_q, block_k, interpret, H, G
+    )
     return _from3(o3, B, H), lse3.reshape(B, H, T)
 
 
 def _bwd_4d(q, k, v, o, do, lse, scale, causal, block_q, block_k, interpret):
     B, T, H, D = q.shape
+    G = k.shape[2]
     dq3, dk3, dv3 = _flash_bwd_pallas(
         _to3(q), _to3(k), _to3(v), _to3(o), _to3(do), lse.reshape(B * H, T, 1),
-        scale, causal, block_q, block_k, interpret,
+        scale, causal, block_q, block_k, interpret, H, G,
     )
-    return _from3(dq3, B, H), _from3(dk3, B, H), _from3(dv3, B, H)
+    return _from3(dq3, B, H), _from3(dk3, B, G), _from3(dv3, B, G)
 
 
 # ---------------------------------------------------- GSPMD partitionability
@@ -262,13 +303,27 @@ def _bwd_4d(q, k, v, o, do, lse, scale, causal, block_q, block_k, interpret):
 # ring/ulysses (parallel/context.py) instead.
 
 
-def _batch_head_axes(arg_shapes):
-    """(batch_axes, head_axes) of the q operand's (suggested) sharding."""
+def _batch_head_axes(mesh, arg_shapes):
+    """(batch_axes, head_axes) of the q operand's (suggested) sharding.
+
+    The head axes are kept only if their total mesh extent divides the
+    kv-head count G (k operand, dim 2): GQA/MQA route q heads to kv groups
+    inside the kernel, which is only shard-local-consistent when the head
+    partitioning splits kv groups evenly.  Otherwise heads are replicated
+    (batch-only partitioning) — e.g. MQA (G=1) under tp."""
     from jax.sharding import PartitionSpec as P
 
     spec = getattr(arg_shapes[0].sharding, "spec", None) or P()
     spec = tuple(spec) + (None,) * (4 - len(tuple(spec)))
-    return spec[0], spec[2]
+    b, h = spec[0], spec[2]
+    if h is not None:
+        G = arg_shapes[1].shape[2]
+        h_extent = 1
+        for name in h if isinstance(h, tuple) else (h,):
+            h_extent *= mesh.shape[name]
+        if G % h_extent:
+            h = None
+    return b, h
 
 
 @functools.lru_cache(maxsize=64)
@@ -281,26 +336,28 @@ def _partitioned_fwd(scale, causal, block_q, block_k, interpret):
         return _fwd_4d(q, k, v, scale, causal, block_q, block_k, interpret)
 
     def infer(mesh, arg_shapes, shape):
-        b, h = _batch_head_axes(arg_shapes)
+        b, h = _batch_head_axes(mesh, arg_shapes)
         return (
             NamedSharding(mesh, P(b, None, h, None)),
             NamedSharding(mesh, P(b, h, None)),
         )
 
     def partition(mesh, arg_shapes, result_shape):
-        b, h = _batch_head_axes(arg_shapes)
+        b, h = _batch_head_axes(mesh, arg_shapes)
         qsh = NamedSharding(mesh, P(b, None, h, None))
         lsh = NamedSharding(mesh, P(b, h, None))
 
         def lower(q, k, v):
             return _fwd_4d(q, k, v, scale, causal, block_q, block_k, interpret)
 
+        # k/v share the head axis on their (smaller) group dim: GQA under tp
+        # needs tp | KV, which every llama/mixtral plan in-tree satisfies
         return mesh, lower, (qsh, lsh), (qsh, qsh, qsh)
 
     fwd.def_partition(
         partition=partition,
         infer_sharding_from_operands=infer,
-        sharding_rule="b t h d, b t h d, b t h d -> b t h d, b h t",
+        sharding_rule="b t h d, b t g d, b t g d -> b t h d, b h t",
         need_replication_factors=("t", "d"),
     )
     return fwd
@@ -316,12 +373,12 @@ def _partitioned_bwd(scale, causal, block_q, block_k, interpret):
         return _bwd_4d(q, k, v, o, do, lse, scale, causal, block_q, block_k, interpret)
 
     def infer(mesh, arg_shapes, shape):
-        b, h = _batch_head_axes(arg_shapes)
+        b, h = _batch_head_axes(mesh, arg_shapes)
         qsh = NamedSharding(mesh, P(b, None, h, None))
         return (qsh, qsh, qsh)
 
     def partition(mesh, arg_shapes, result_shape):
-        b, h = _batch_head_axes(arg_shapes)
+        b, h = _batch_head_axes(mesh, arg_shapes)
         qsh = NamedSharding(mesh, P(b, None, h, None))
         lsh = NamedSharding(mesh, P(b, h, None))
 
@@ -334,8 +391,8 @@ def _partitioned_bwd(scale, causal, block_q, block_k, interpret):
         partition=partition,
         infer_sharding_from_operands=infer,
         sharding_rule=(
-            "b t h d, b t h d, b t h d, b t h d, b t h d, b h t"
-            " -> b t h d, b t h d, b t h d"
+            "b t h d, b t g d, b t g d, b t h d, b t h d, b h t"
+            " -> b t h d, b t g d, b t g d"
         ),
         need_replication_factors=("t", "d"),
     )
@@ -371,10 +428,16 @@ def flash_attention(
     block_k: int = 512,
     interpret: Optional[bool] = None,
 ):
-    """Fused attention over (B, T, H, D) q/k/v.  GQA callers repeat K/V
-    heads first (as models/llama does).  Divisibility: T % block sizes == 0
-    (pad upstream); off-TPU falls back to the jnp reference."""
+    """Fused attention over (B, T, H, D) q with (B, T, G, D) k/v, G | H —
+    GQA/MQA run natively: the kernels route each q head to its kv group via
+    BlockSpec index maps, so the repeated K/V heads are never materialized
+    in HBM (vs the torch-reference pattern of repeat_kv before SDPA).
+    Divisibility: T % block sizes == 0 (pad upstream); off-TPU falls back to
+    the jnp reference."""
     B, T, H, D = q.shape
+    G = k.shape[2]
+    if H % max(G, 1):
+        raise ValueError(f"q heads {H} not a multiple of kv heads {G}")
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     on_tpu = jax.devices()[0].platform == "tpu"
     if interpret is None:

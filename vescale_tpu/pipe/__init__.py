@@ -9,3 +9,20 @@ from .schedules import (
     build_schedule,
 )
 from .engine import PipeEngine
+from .graph_split import GraphPipeModule, split_graph
+
+
+def build_pipe_module(plan, *, units=None, fn=None, params_example=None, x_example=None):
+    """Construct a pipeline module per ``plan.tracer_type`` (the reference's
+    PipeParser.parse dispatch, pipe_parser.py:60): MODULE_PATH splits an
+    explicit ``units`` list; JAXPR (and the torch tracer aliases) auto-splits
+    the traced ``fn(params, x)`` graph."""
+    from ..plan import TracerType
+
+    if plan.tracer_type == TracerType.MODULE_PATH:
+        if units is None:
+            raise ValueError("MODULE_PATH tracer needs `units`")
+        return construct_pipeline_stage(units, plan, x_example)
+    if fn is None or params_example is None or x_example is None:
+        raise ValueError(f"{plan.tracer_type} tracer needs fn, params_example and x_example")
+    return split_graph(fn, params_example, x_example, plan)

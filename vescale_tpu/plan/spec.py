@@ -37,14 +37,19 @@ class PipelineScheduleType(enum.Enum):
 
 
 class TracerType(enum.Enum):
-    """The reference's fx/HF/dynamo tracers (pipe/tracer.py:81,93) do not
-    exist on TPU — module-path splitting covers GRAPH_EAGER (SURVEY §7.6).
-    Kept for plan-compat."""
+    """How a model is decomposed into pipeline stages.  The reference's
+    fx/HF/dynamo tracers (pipe/tracer.py:81,93) map to two TPU-native modes:
+    MODULE_PATH splits an explicit stage-unit list (pipe_stage.py), JAXPR
+    traces the model function and cuts its equation graph with a FLOP cost
+    model (graph_split.py) — full graph-level auto-split for models that are
+    not block lists.  The torch names are kept for plan-compat and alias to
+    JAXPR."""
 
     VESCALE_FX = "vescale_fx"
     HF_FX = "hf_fx"
     TORCH_DYNAMO = "dynamo"
-    MODULE_PATH = "module_path"  # the TPU-native mode
+    MODULE_PATH = "module_path"  # explicit stage-unit lists
+    JAXPR = "jaxpr"              # graph-level auto-split (pipe/graph_split.py)
 
 
 @dataclasses.dataclass
