@@ -118,6 +118,34 @@ def test_auto_parallelize_module(mesh2d):
     np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)
 
 
+def test_auto_parallelize_scanned_llama(mesh2d):
+    """MEGATRON policy classifies lax.scan-stacked (L, in, out) kernels with
+    the stack-shifted shard dims."""
+    from vescale_tpu.dmp import auto_parallelize_module
+    from vescale_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=32,
+        dtype=jnp.float32, scan_layers=True,
+    )
+    idx = jnp.ones((2, 8), jnp.int32)
+    dm = auto_parallelize_module(Llama(cfg), mesh2d, idx)
+    variables = dm.init(jax.random.key(0), idx)
+    blk = variables["params"]["layers"]["block"]
+    def norm3(spec):
+        return tuple(spec) + (None,) * (3 - len(tuple(spec)))
+
+    q = blk["self_attn"]["q_proj"]["kernel"]
+    assert q.ndim == 3
+    assert norm3(q.sharding.spec) == (None, None, "tp")  # col shard shifted past stack
+    o = blk["self_attn"]["o_proj"]["kernel"]
+    assert norm3(o.sharding.spec) == (None, "tp", None)  # row shard shifted past stack
+    out = dm.apply(variables, idx)
+    golden = Llama(cfg).apply(variables, idx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)
+
+
 # ---------------------------------------------------------------- ndtimeline
 def test_ndtimeline(tmp_path):
     from vescale_tpu.ndtimeline import (

@@ -62,13 +62,16 @@ def megatron_policy(abstract_params, mesh, tp_dim: str = "tp", dp_dim: str = "dp
             d = 1 if len(shape) > 1 and shape[1] % n_tp == 0 else None
             param_plan[key] = pl(d)
             return leaf
-        if len(shape) == 2 and low.endswith("kernel"):
+        if len(shape) in (2, 3) and low.endswith("kernel"):
+            # 3-D = lax.scan-stacked blocks (L, in, out): the leading stack
+            # axis is never a tp dim, so col/row shard dims shift by one
+            off = len(shape) - 2
             parent = low.rsplit(".", 2)[-2] if "." in low else low
-            if any(h in parent for h in _COL_HINTS) and shape[1] % n_tp == 0:
-                param_plan[key] = pl(1)
+            if any(h in parent for h in _COL_HINTS) and shape[1 + off] % n_tp == 0:
+                param_plan[key] = pl(1 + off)
                 return leaf
-            if any(h in parent for h in _ROW_HINTS) and shape[0] % n_tp == 0:
-                param_plan[key] = pl(0)
+            if any(h in parent for h in _ROW_HINTS) and shape[0 + off] % n_tp == 0:
+                param_plan[key] = pl(0 + off)
                 return leaf
             param_plan[key] = pl()
             return leaf

@@ -143,6 +143,7 @@ class GraphPipeModule:
 
         # dataflow at each boundary: defs before the cut, uses at/after it
         var_of_param = dict(zip(self._param_vars, self._param_names))
+        var_of_name = dict(zip(self._param_names, self._param_vars))
         self._carry_vars: List[List[Any]] = []  # carry INTO group g (g>=1)
         self._group_params: List[List[Tuple[str, Any]]] = []
         use_after: List[set] = [set() for _ in range(n + 1)]
@@ -157,7 +158,7 @@ class GraphPipeModule:
             lo, hi = self._bounds[g], self._bounds[g + 1]
             used = set(v for e in eqns[lo:hi] for v in _eqn_invars(e))
             pnames = sorted({var_of_param[v] for v in used if v in var_of_param})
-            self._group_params.append([(nm, self._param_vars[self._param_names.index(nm)]) for nm in pnames])
+            self._group_params.append([(nm, var_of_name[nm]) for nm in pnames])
             if g > 0:
                 # carry = non-param, non-const values defined earlier and
                 # still needed by this group or any later one (incl. outputs)
