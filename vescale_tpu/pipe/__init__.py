@@ -2,10 +2,13 @@ from .pipe_stage import PipeModule, construct_pipeline_stage
 from .schedules import (
     Instruction,
     InstructionKind,
+    StageCosts,
     gpipe_schedule,
     one_f_one_b_schedule,
     interleaved_1f1b_schedule,
     zero_bubble_schedule,
+    zero_bubble_cost_schedule,
+    simulate_schedule,
     build_schedule,
 )
 from .engine import PipeEngine

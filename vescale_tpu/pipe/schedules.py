@@ -20,17 +20,21 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import List
+import functools
+from typing import List, Optional, Sequence, Tuple, Union
 
 from ..plan import PipelineParallelPlan, PipelineScheduleType
 
 __all__ = [
     "InstructionKind",
     "Instruction",
+    "StageCosts",
     "gpipe_schedule",
     "one_f_one_b_schedule",
     "interleaved_1f1b_schedule",
     "zero_bubble_schedule",
+    "zero_bubble_cost_schedule",
+    "simulate_schedule",
     "build_schedule",
 ]
 
@@ -169,8 +173,260 @@ def zero_bubble_schedule(num_stages: int, num_microbatches: int) -> List[List[In
     return out
 
 
-def build_schedule(plan: PipelineParallelPlan, num_microbatches: int) -> List[List[Instruction]]:
-    """Reference ScheduleEngine/PipelineEmitter dispatch (pipe_emmiter.py:132)."""
+# --------------------------------------------------- cost-graph scheduling
+@dataclasses.dataclass(frozen=True)
+class StageCosts:
+    """Per-stage instruction costs driving cost-aware schedule generation —
+    the role of the reference's profiled CostGraph (zero_bubble_v.py:198:
+    per-ScheduledNode F/B/W durations + comm edges).
+
+    ``f``/``bd``/``w``: cost of one FORWARD / BACKWARD_DGRAD /
+    BACKWARD_WGRAD per stage (len ``num_stages``); a fused BACKWARD costs
+    ``bd + w``.  ``comm``: activation/cotangent hop cost between adjacent
+    stages (the reference's p2p edge weight; on TPU an ICI transfer)."""
+
+    f: Tuple[float, ...]
+    bd: Tuple[float, ...]
+    w: Tuple[float, ...]
+    comm: float = 0.0
+
+    def __post_init__(self):
+        # frozen dataclass doubles as the schedule-cache key: coerce
+        # sequence fields so list-built instances stay hashable
+        for name in ("f", "bd", "w"):
+            object.__setattr__(self, name, tuple(float(x) for x in getattr(self, name)))
+
+    @staticmethod
+    def uniform(num_stages: int, f: float = 1.0, bd: float = 1.0,
+                w: float = 1.0, comm: float = 0.0) -> "StageCosts":
+        return StageCosts((f,) * num_stages, (bd,) * num_stages, (w,) * num_stages, comm)
+
+    @staticmethod
+    def from_weights(weights: Sequence[float], comm: float = 0.0) -> "StageCosts":
+        """Costs proportional to per-stage work (e.g. param or FLOP counts):
+        dgrad and wgrad each cost about one forward (2 matmuls vs 1 per
+        linear map — the standard 1:1:1 F:Bd:W ratio the ZB paper assumes)."""
+        t = tuple(float(x) for x in weights)
+        return StageCosts(t, t, t, comm)
+
+    def of(self, ins: Instruction) -> float:
+        k = ins.kind
+        if k == InstructionKind.FORWARD:
+            return self.f[ins.stage]
+        if k == InstructionKind.BACKWARD:
+            return self.bd[ins.stage] + self.w[ins.stage]
+        if k == InstructionKind.BACKWARD_DGRAD:
+            return self.bd[ins.stage]
+        return self.w[ins.stage]
+
+
+def _dep_key(ins: Instruction):
+    return (ins.kind, ins.stage, ins.microbatch)
+
+
+def _deps(ins: Instruction, num_stages: int) -> List[Tuple[InstructionKind, int, int]]:
+    """Predecessor completion events of ``ins`` (V=1 dependency graph — the
+    edges of the reference CostGraph)."""
+    F, B = InstructionKind.FORWARD, InstructionKind.BACKWARD
+    Bd, W = InstructionKind.BACKWARD_DGRAD, InstructionKind.BACKWARD_WGRAD
+    s, m = ins.stage, ins.microbatch
+    if ins.kind == F:
+        return [(F, s - 1, m)] if s > 0 else []
+    if ins.kind in (B, Bd):
+        deps = [(F, s, m)]
+        if s < num_stages - 1:
+            # the downstream stage produces our cotangent with its dgrad
+            # (or fused backward — whichever that stage's schedule uses)
+            deps.append(("cot", s + 1, m))
+        return deps
+    if ins.kind == W:
+        return [(Bd, s, m)]
+    return []
+
+
+def _ready_time(ins: Instruction, done: dict, num_stages: int, costs: StageCosts) -> Optional[float]:
+    """Earliest start of ``ins`` given completion times ``done`` — the ONE
+    encoding of the dependency/hop rules, shared by the simulator and the
+    greedy generator so their cost models can never drift apart.  None if a
+    predecessor hasn't completed."""
+    t = 0.0
+    for dep in _deps(ins, num_stages):
+        if dep[0] == "cot":
+            _, ds, dm = dep
+            key = (InstructionKind.BACKWARD_DGRAD, ds, dm)
+            if key not in done:
+                key = (InstructionKind.BACKWARD, ds, dm)
+            if key not in done:
+                return None
+            t = max(t, done[key] + costs.comm)
+        else:
+            if dep not in done:
+                return None
+            hop = costs.comm if dep[0] == InstructionKind.FORWARD and dep[1] != ins.stage else 0.0
+            t = max(t, done[dep] + hop)
+    return t
+
+
+def simulate_schedule(
+    schedule: List[List[Instruction]],
+    costs: StageCosts,
+) -> float:
+    """Event-driven makespan of a per-stage instruction schedule under the
+    cost model: stages execute their lists in order (each stage is a serial
+    resource), cross-stage edges add ``costs.comm``.  Virtual chunks are not
+    modeled (the compiled spmd.py path owns interleaving).  Returns the time
+    the last instruction completes."""
+    S = len(schedule)
+    if len(costs.f) != S or len(costs.bd) != S or len(costs.w) != S:
+        raise ValueError(
+            f"StageCosts for {len(costs.f)} stages used with a {S}-stage schedule"
+        )
+    done: dict = {}
+
+    def ready_at(ins: Instruction) -> Optional[float]:
+        return _ready_time(ins, done, S, costs)
+
+    for stage_ins in schedule:
+        for ins in stage_ins:
+            if ins.chunk:
+                raise NotImplementedError("simulate_schedule models V=1 only")
+
+    stage_time = [0.0] * S
+    pos = [0] * S
+    makespan = 0.0
+    while any(p < len(q) for p, q in zip(pos, schedule)):
+        progressed = False
+        for s in range(S):
+            while pos[s] < len(schedule[s]):
+                ins = schedule[s][pos[s]]
+                t = ready_at(ins)
+                if t is None:
+                    break
+                start = max(stage_time[s], t)
+                end = start + costs.of(ins)
+                done[_dep_key(ins)] = end
+                stage_time[s] = end
+                makespan = max(makespan, end)
+                pos[s] += 1
+                progressed = True
+        if not progressed:
+            stuck = [q[p] for p, q in zip(pos, schedule) if p < len(q)]
+            raise RuntimeError(f"schedule deadlock in simulation; waiting on {stuck[:8]}")
+    return makespan
+
+
+def _zb_greedy_schedule(
+    num_stages: int,
+    num_microbatches: int,
+    costs: StageCosts,
+) -> List[List[Instruction]]:
+    """Global-clock greedy over the ZB dependency graph: repeatedly start the
+    schedulable instruction with the earliest feasible start time, preferring
+    dgrad > forward > wgrad on ties — W work naturally slots into gaps whose
+    length the cost model exposes (the reference generator's rollout,
+    zero_bubble_v.py:602).
+
+    Memory bound: stage ``s`` may hold at most ``S - s`` (the 1F1B/ZB-H1
+    warmup depth) forwards whose WGRAD hasn't run.  The engine pins each
+    forward's linearization residuals until BACKWARD_WGRAD pops them
+    (engine.py wgrad_stash), so the bound must count F minus W — not F minus
+    Bd — or the rollout trades O(M) residual memory for makespan the way the
+    reference's memory-limited CostGraph deliberately does not."""
+    S, M = num_stages, num_microbatches
+    F, Bd, W = InstructionKind.FORWARD, InstructionKind.BACKWARD_DGRAD, InstructionKind.BACKWARD_WGRAD
+    prio = {Bd: 0, F: 1, W: 2}
+    done: dict = {}
+    stage_time = [0.0] * S
+    schedule: List[List[Instruction]] = [[] for _ in range(S)]
+    fptr, bptr, wptr = [0] * S, [0] * S, [0] * S
+    cap = [max(1, S - s) for s in range(S)]
+
+    def candidates(s):
+        out = []
+        nxt = []
+        if fptr[s] < M and fptr[s] - wptr[s] < cap[s]:
+            nxt.append(Instruction(F, s, fptr[s]))
+        if bptr[s] < M:
+            nxt.append(Instruction(Bd, s, bptr[s]))
+        if wptr[s] < bptr[s]:  # wgrad ready once its dgrad has run
+            nxt.append(Instruction(W, s, wptr[s]))
+        for ins in nxt:
+            rdy = _ready_time(ins, done, S, costs)
+            if rdy is not None:
+                out.append((ins, rdy))
+        return out
+
+    total = 3 * M * S
+    scheduled = 0
+    while scheduled < total:
+        best = None
+        for s in range(S):
+            for ins, rdy in candidates(s):
+                start = max(stage_time[s], rdy)
+                key = (start, prio[ins.kind], s)
+                if best is None or key < best[0]:
+                    best = (key, ins, start)
+        if best is None:
+            raise RuntimeError("zb greedy scheduler stalled (dependency bug)")
+        _, ins, start = best
+        s = ins.stage
+        end = start + costs.of(ins)
+        done[_dep_key(ins)] = end
+        stage_time[s] = end
+        schedule[s].append(ins)
+        if ins.kind == F:
+            fptr[s] += 1
+        elif ins.kind == Bd:
+            bptr[s] += 1
+        else:
+            wptr[s] += 1
+        scheduled += 1
+    return schedule
+
+
+@functools.lru_cache(maxsize=256)
+def _zb_cost_schedule_cached(num_stages: int, num_microbatches: int, costs: StageCosts):
+    cands = [
+        zero_bubble_schedule(num_stages, num_microbatches),
+        _zb_greedy_schedule(num_stages, num_microbatches, costs),
+    ]
+    return min(cands, key=lambda sch: simulate_schedule(sch, costs))
+
+
+def zero_bubble_cost_schedule(
+    num_stages: int,
+    num_microbatches: int,
+    costs: Union[StageCosts, Sequence[float], None] = None,
+) -> List[List[Instruction]]:
+    """Cost-aware zero-bubble schedule (reference CostGraph generator,
+    zero_bubble_v.py:198,602): generate candidate schedules — the fixed-defer
+    ZB-H1 heuristic and a cost-model greedy rollout — simulate each under the
+    cost model, and return the one with the smallest makespan.
+
+    ``costs``: a ``StageCosts``, a per-stage weight sequence (param/FLOP
+    counts — 1:1:1 F:Bd:W assumed), or None (uniform).  Results are memoized
+    per (S, M, costs): a training loop re-building its schedule every step
+    pays the Python rollout once."""
+    if costs is None:
+        costs = StageCosts.uniform(num_stages)
+    elif not isinstance(costs, StageCosts):
+        costs = StageCosts.from_weights(costs)
+    if len(costs.f) != num_stages or len(costs.bd) != num_stages or len(costs.w) != num_stages:
+        raise ValueError(
+            f"schedule_costs has {len(costs.f)} stages, plan has {num_stages}"
+        )
+    cached = _zb_cost_schedule_cached(num_stages, num_microbatches, costs)
+    return [list(stage) for stage in cached]  # callers may mutate their copy
+
+
+def build_schedule(
+    plan: PipelineParallelPlan,
+    num_microbatches: int,
+    costs: Optional[StageCosts] = None,
+) -> List[List[Instruction]]:
+    """Reference ScheduleEngine/PipelineEmitter dispatch (pipe_emmiter.py:132).
+    ``costs`` (or ``plan.schedule_costs``) routes ZERO_BUBBLE through the
+    cost-graph generator."""
     st = plan.schedule_type
     if st == PipelineScheduleType.GPIPE:
         return gpipe_schedule(plan.num_stages, num_microbatches)
@@ -179,5 +435,8 @@ def build_schedule(plan: PipelineParallelPlan, num_microbatches: int) -> List[Li
     if st == PipelineScheduleType.INTERLEAVED_1F1B:
         return interleaved_1f1b_schedule(plan.num_stages, num_microbatches, plan.virtual_chunks)
     if st == PipelineScheduleType.ZERO_BUBBLE:
+        costs = costs if costs is not None else plan.schedule_costs
+        if costs is not None:
+            return zero_bubble_cost_schedule(plan.num_stages, num_microbatches, costs)
         return zero_bubble_schedule(plan.num_stages, num_microbatches)
     raise NotImplementedError(f"schedule {st}")

@@ -28,6 +28,10 @@ class PipelineParallelPlan:
     p2p_tensor_shapes: Optional[Any] = None
     reuse_p2p_tensor_shape: bool = False
     forward_only: bool = False
+    # cost model for ZERO_BUBBLE (pipe.schedules.StageCosts or per-stage
+    # weights): routes scheduling through the cost-graph generator, the
+    # analog of the reference's profiled CostGraph (zero_bubble_v.py:198)
+    schedule_costs: Optional[Any] = None
 
     def __post_init__(self):
         if self.schedule_type == PipelineScheduleType.INTERLEAVED_1F1B and self.virtual_chunks < 2:
