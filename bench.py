@@ -24,6 +24,8 @@ import numpy as np
 def peak_flops_per_chip(device) -> float:
     kind = getattr(device, "device_kind", "").lower()
     plat = device.platform.lower()
+    if "v6" in kind:
+        return 918e12  # v6e (Trillium) bf16
     if "v5p" in kind:
         return 459e12
     if "v5" in kind or "v5e" in kind or "lite" in kind:
