@@ -40,6 +40,8 @@ def run(variant: str):
         "dots_b1": (1, dict(remat=True, remat_policy="dots_saveable")),
         "dots_nobatch_b2": (2, dict(remat=True, remat_policy="dots_with_no_batch_dims_saveable")),
         "noremat_b1": (1, dict()),
+        "mlpremat_b1": (1, dict(remat=True, remat_scope="mlp")),
+        "mlpremat_b2": (2, dict(remat=True, remat_scope="mlp")),
     }
     B, extra = variants[variant]
     cfg = LlamaConfig(**{**base, **extra})

@@ -187,3 +187,46 @@ def test_llama_remat_policy_without_remat_raises():
 
     with pytest.raises(ValueError, match="remat_policy"):
         dataclasses.replace(TINY_LLAMA, remat_policy="dots_saveable")
+
+
+def test_llama_remat_scope_mlp_matches():
+    """remat_scope='mlp' (attention residuals live, MLP rematerialized) is a
+    pure scheduling choice: loss and grads bitwise-match remat_scope='block'
+    and no-remat, and param FQNs are unchanged."""
+    import dataclasses
+
+    from vescale_tpu.models.llama import Llama
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
+
+    base = dataclasses.replace(TINY_LLAMA, dtype=jnp.float32)
+    idx = jax.random.randint(jax.random.key(0), (2, 17), 0, base.vocab_size)
+    batch = {"input": idx[:, :-1], "target": idx[:, 1:]}
+    params = Llama(base).init(jax.random.key(1), batch["input"])["params"]
+
+    def loss_grads(cfg):
+        def f(p):
+            return cross_entropy_loss(
+                Llama(cfg).apply({"params": p}, batch["input"]), batch["target"]
+            )
+        return jax.value_and_grad(f)(params)
+
+    l0, g0 = loss_grads(base)
+    for cfg in (
+        dataclasses.replace(base, remat=True, remat_scope="block"),
+        dataclasses.replace(base, remat=True, remat_scope="mlp"),
+    ):
+        # same tree structure (FQNs unchanged by the remat wrapper)
+        l1, g1 = loss_grads(cfg)
+        np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+        assert jax.tree_util.tree_structure(g1) == jax.tree_util.tree_structure(g0)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g0), strict=True
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="remat_scope"):
+        dataclasses.replace(base, remat=True, remat_scope="attention")
+    with pytest.raises(ValueError, match="remat_scope"):
+        dataclasses.replace(base, remat_scope="mlp")  # remat=False: silent no-op guarded
+    with pytest.raises(ValueError, match="block"):
+        dataclasses.replace(base, remat=True, remat_scope="mlp", remat_policy="dots_saveable")

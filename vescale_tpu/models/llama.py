@@ -59,6 +59,14 @@ class LlamaConfig:
     # from ~25% (full recompute) to ~0 while still dropping the elementwise
     # intermediates that dominate activation HBM.  None = full remat.
     remat_policy: Optional[str] = None
+    # what to rematerialize when remat=True:
+    #   "block" — jax.checkpoint the whole block (max HBM savings, pays a
+    #             full forward recompute incl. the flash-attention kernel);
+    #   "mlp"   — checkpoint only the MLP: attention residuals (q/k/v/o/lse,
+    #             the flash kernel's saved state) stay live, so backward
+    #             reuses the fused kernel's forward instead of re-running it
+    #             — ~O(5*B*T*E) more HBM per layer for less recompute.
+    remat_scope: str = "block"
     # lax.scan over layers: XLA compiles ONE block instead of L copies
     # (minutes -> seconds at 24+ layers; same step math).  Params gain a
     # leading (L,) axis — shard them with pipe.spmd.shard_stacked_params or
@@ -72,6 +80,15 @@ class LlamaConfig:
                 "remat_policy is set but remat=False — the policy would be "
                 "silently ignored; set remat=True (or drop the policy)"
             )
+        if self.remat_scope not in ("block", "mlp"):
+            raise ValueError(f"remat_scope must be 'block' or 'mlp', got {self.remat_scope!r}")
+        if self.remat_scope != "block" and not self.remat:
+            raise ValueError(
+                "remat_scope is set but remat=False — the scope would be "
+                "silently ignored; set remat=True (or drop the scope)"
+            )
+        if self.remat_policy and self.remat_scope != "block":
+            raise ValueError("remat_policy applies to remat_scope='block' only")
 
     @property
     def head_dim(self) -> int:
@@ -190,10 +207,19 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions):
         c = self.config
+        # remat_scope="mlp": checkpoint applied here (Llama skips the
+        # block-level wrap); nn.remat preserves the submodule name, so
+        # param FQNs — and every plan/checkpoint keyed on them — are
+        # unchanged across scopes
+        mlp_cls = (
+            nn.remat(LlamaMLP, prevent_cse=not c.scan_layers)
+            if (c.remat and c.remat_scope == "mlp")
+            else LlamaMLP
+        )
         x = x + LlamaAttention(c, name="self_attn")(
             RMSNorm(c.rms_norm_eps, c.dtype, name="input_layernorm")(x), positions
         )
-        x = x + LlamaMLP(c, name="mlp")(
+        x = x + mlp_cls(c, name="mlp")(
             RMSNorm(c.rms_norm_eps, c.dtype, name="post_attention_layernorm")(x)
         )
         return x
@@ -222,13 +248,13 @@ class Llama(nn.Module):
         emb = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype, name="embed_tokens")
         x = emb(idx)
         positions = jnp.arange(T)[None, :].repeat(B, axis=0)
-        if c.remat:
+        if c.remat and c.remat_scope == "block":
             policy = getattr(jax.checkpoint_policies, c.remat_policy) if c.remat_policy else None
             # inside scan the loop structure already blocks CSE; prevent_cse
             # there would only pessimize the compiled body
             block_cls = nn.remat(LlamaBlock, policy=policy, prevent_cse=not c.scan_layers)
         else:
-            block_cls = LlamaBlock
+            block_cls = LlamaBlock  # scope="mlp" remat happens inside the block
         if c.scan_layers:
             scan = nn.scan(
                 _scan_body(block_cls),
