@@ -177,6 +177,7 @@ def bench_longctx():
             dtype=jnp.bfloat16,
             use_flash_attention=True,
             remat=True,
+            remat_scope="mlp",  # attention residuals fit at 350M; skip kernel recompute
         )
         metric = "llama350m_longctx_MFU_1chip_seq32768"
     else:
