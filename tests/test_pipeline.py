@@ -504,3 +504,33 @@ def test_stage_costs_hashable_from_lists():
     costs = StageCosts(f=[1.0, 1.0], bd=[1.0, 1.0], w=[1.0, 1.0])
     sched = zero_bubble_cost_schedule(2, 2, costs)
     _schedule_well_formed(sched, 2, 2, zb=True)
+
+
+def test_estimate_stage_costs_from_flop_model():
+    """estimate_stage_costs traces each group and totals the graph FLOP
+    model (the reference CostGraph's profiling role): transformer-block
+    stages get near-equal weights, the embed/head stages differ, and the
+    result drives a valid cost schedule end-to-end."""
+    from vescale_tpu.pipe import StageCosts, estimate_stage_costs, zero_bubble_cost_schedule
+
+    units = gpt_pipeline_units(CFG)
+    plan = PipelineParallelPlan(num_stages=4, schedule_type=PipelineScheduleType.ZERO_BUBBLE)
+    pm = construct_pipeline_stage(units, plan)
+    params = pm.init_all(jax.random.key(0), jnp.ones((2, CFG.block_size), jnp.int32))
+    x_example = jnp.ones((2, CFG.block_size), jnp.int32)
+    costs = estimate_stage_costs(pm, params, x_example, comm=0.0)
+    assert isinstance(costs, StageCosts) and len(costs.f) == 4
+    assert all(w > 0 for w in costs.f)
+    # the two middle stages are pure transformer blocks: equal FLOPs
+    assert costs.f[1] == pytest.approx(costs.f[2], rel=1e-6)
+    sched = zero_bubble_cost_schedule(4, 8, costs)
+    _schedule_well_formed(sched, 4, 8, zb=True)
+
+    # the costs route through the engine unchanged
+    plan.schedule_costs = costs
+    engine = PipeEngine(pm, plan, cross_entropy_loss)
+    toks = jax.random.randint(jax.random.key(1), (8, CFG.block_size + 1), 0, CFG.vocab_size)
+    loss, grads = engine.forward_backward(
+        params, {"input": toks[:, :-1], "target": toks[:, 1:]}, num_microbatches=4
+    )
+    assert np.isfinite(float(loss))

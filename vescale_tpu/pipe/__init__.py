@@ -9,6 +9,7 @@ from .schedules import (
     zero_bubble_schedule,
     zero_bubble_cost_schedule,
     simulate_schedule,
+    estimate_stage_costs,
     build_schedule,
 )
 from .engine import PipeEngine

@@ -43,7 +43,7 @@ except ImportError:  # pragma: no cover - older/newer jax layouts
 from ..plan import PipelineParallelPlan
 from .pipe_stage import _cuts_by_weight
 
-__all__ = ["GraphPipeModule", "split_graph"]
+__all__ = ["GraphPipeModule", "split_graph", "jaxpr_flops"]
 
 
 # ------------------------------------------------------------- cost model
@@ -79,6 +79,26 @@ def _eqn_flops(eqn) -> float:
     total = 0.0
     for ov in eqn.outvars:
         total += getattr(ov.aval, "size", 0)
+    return total
+
+
+def jaxpr_flops(jaxpr) -> float:
+    """Total FLOPs of a (closed) jaxpr under the same cost model, recursing
+    into call/sub-jaxprs (pjit, remat, custom_vjp, scan, cond branches)."""
+    j = getattr(jaxpr, "jaxpr", jaxpr)
+    total = 0.0
+    for eqn in j.eqns:
+        subs = []
+        for v in eqn.params.values():
+            if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+                subs.append(v)
+            elif isinstance(v, (tuple, list)):
+                subs.extend(x for x in v if hasattr(x, "eqns") or hasattr(x, "jaxpr"))
+        if subs:
+            mult = eqn.params.get("length", 1) if eqn.primitive.name == "scan" else 1
+            total += mult * sum(jaxpr_flops(s) for s in subs)
+        else:
+            total += _eqn_flops(eqn)
     return total
 
 

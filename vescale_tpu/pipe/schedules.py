@@ -35,6 +35,7 @@ __all__ = [
     "zero_bubble_schedule",
     "zero_bubble_cost_schedule",
     "simulate_schedule",
+    "estimate_stage_costs",
     "build_schedule",
 ]
 
@@ -417,6 +418,25 @@ def zero_bubble_cost_schedule(
         )
     cached = _zb_cost_schedule_cached(num_stages, num_microbatches, costs)
     return [list(stage) for stage in cached]  # callers may mutate their copy
+
+
+def estimate_stage_costs(pipe_module, params_per_group, x_example, comm: float = 0.0) -> StageCosts:
+    """Per-stage costs from the graph FLOP model — the profiling role of the
+    reference's CostGraph (zero_bubble_v.py:198): trace each group's forward
+    (``jax.make_jaxpr`` on avals, no execution), total its FLOPs, and assume
+    the standard 1:1:1 F:Bd:W ratio.  ``x_example`` is the stage-0 input
+    (array or ShapeDtypeStruct); activations chain through ``eval_shape``.
+    Requires one group per stage (V=1, the cost-schedule's domain)."""
+    import jax
+
+    from .graph_split import jaxpr_flops
+
+    weights, x = [], x_example
+    for g in range(pipe_module.num_groups):
+        fwd = pipe_module.group_forward(g)
+        weights.append(jaxpr_flops(jax.make_jaxpr(fwd)(params_per_group[g], x)))
+        x = jax.eval_shape(fwd, params_per_group[g], x)
+    return StageCosts.from_weights(weights, comm=comm)
 
 
 def build_schedule(
