@@ -178,6 +178,8 @@ def bench_longctx():
             use_flash_attention=True,
             remat=True,
             remat_scope="mlp",  # attention residuals fit at 350M; skip kernel recompute
+            scan_layers=True,   # ONE compiled block: 24-layer unrolled XLA at
+                                # seq 32k takes tens of minutes to optimize
         )
         metric = "llama350m_longctx_MFU_1chip_seq32768"
     else:
@@ -190,7 +192,7 @@ def bench_longctx():
         metric = "llama_longctx_cpu_smoke_MFU"
 
     mesh = DeviceMesh(("dp", "tp"), (n, 1), devices=devices)
-    dm = parallelize_module(Llama(cfg), mesh, llama_plan(mesh, sequence_parallel=False))
+    dm = parallelize_module(Llama(cfg), mesh, llama_plan(mesh, sequence_parallel=False, scanned=cfg.scan_layers))
     params = dm.init(jax.random.key(0), jnp.ones((1, T), jnp.int32))["params"]
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
     tx = adamw_lowmem(3e-4)

@@ -42,6 +42,13 @@ def run(variant: str):
         "noremat_b1": (1, dict()),
         "mlpremat_b1": (1, dict(remat=True, remat_scope="mlp")),
         "mlpremat_b2": (2, dict(remat=True, remat_scope="mlp")),
+        # 2B-class rung: muon's single bf16 momentum + bf16-moment adam
+        # fallback halves optimizer state vs fp32 adam (params stay fp32
+        # flax default, so ~2B is the ceiling on a 16 GB chip)
+        "muon2b_b1": (1, dict(
+            hidden_size=2304, intermediate_size=6144, num_hidden_layers=30,
+            num_attention_heads=18, num_key_value_heads=9, remat=True,
+        )),
     }
     B, extra = variants[variant]
     cfg = LlamaConfig(**{**base, **extra})
@@ -52,7 +59,12 @@ def run(variant: str):
     params = dm.init(jax.random.key(0), jnp.ones((1, T), jnp.int32))["params"]
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
     print(f"{variant}: params={n_params/1e9:.3f}B  B={B}", flush=True)
-    tx = adamw_lowmem(3e-4)
+    if variant.startswith("muon"):
+        from vescale_tpu.parallel.optimizer import muon
+
+        tx = muon(0.02, fallback=adamw_lowmem(3e-4), state_dtype=jnp.bfloat16)
+    else:
+        tx = adamw_lowmem(3e-4)
     opt_state = tx.init(params)
     step = make_train_step(dm, tx, lambda lg, b: cross_entropy_loss(lg, b["target"]), donate=True)
     toks = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (B, T + 1)), jnp.int32)

@@ -236,3 +236,26 @@ def test_adamw_lowmem_composes_with_zero(mesh2d):
     assert "dp" in [a for axes in mu.sharding.spec if axes for a in (axes if isinstance(axes, tuple) else (axes,))]
     updates, state = tx.update({"w": jnp.full((8, 16), 0.1, jnp.bfloat16)}, state, params)
     assert jnp.isfinite(updates["w"].astype(jnp.float32)).all()
+
+
+def test_muon_scale_and_state_dtype():
+    """Muon's per-matrix LR scale is sqrt(max(1, fan_out/fan_in)) in flax's
+    (in, out) kernel layout, and state_dtype stores momentum low-precision."""
+    import optax
+
+    from vescale_tpu.parallel.optimizer import muon
+
+    tx = muon(1.0, momentum=0.0, nesterov=False, ns_steps=5, state_dtype=jnp.bfloat16)
+    params = {"wide": {"kernel": jnp.zeros((4, 64))}, "tall": {"kernel": jnp.zeros((64, 4))}}
+    state = tx.init(params)
+    mom = jax.tree_util.tree_leaves(state)[0]
+    assert all(m.dtype == jnp.bfloat16 for m in jax.tree_util.tree_leaves(state)
+               if hasattr(m, "dtype") and m.ndim == 2)
+    g = {"wide": {"kernel": jnp.eye(4, 64)}, "tall": {"kernel": jnp.eye(64, 4)}}
+    updates, _ = tx.update(g, state, params)
+    # identity-like grads orthogonalize to ~identity: the update magnitude
+    # reflects the scale. fan_out > fan_in ("wide", expansion) gets
+    # sqrt(64/4) = 4x the LR of the projection ("tall"), not the reverse.
+    wide = float(jnp.abs(updates["wide"]["kernel"]).max())
+    tall = float(jnp.abs(updates["tall"]["kernel"]).max())
+    assert wide > 2.5 * tall, (wide, tall)

@@ -339,24 +339,34 @@ def muon(
     nesterov: bool = True,
     ns_steps: int = 5,
     fallback: Optional[optax.GradientTransformation] = None,
+    state_dtype=None,
 ) -> optax.GradientTransformation:
     """Muon optimizer: momentum + Newton-Schulz orthogonalized updates for
     2-D params; ``fallback`` (default adamw 3e-4) for others.  The
     reference's gather-compute-scatter over RaggedShard params
     (raggedshard.md) is GSPMD-implicit: the NS matmuls force an all-gather
-    of the 2-D param's gradient, and the result re-shards on write."""
+    of the 2-D param's gradient, and the result re-shards on write.
+    ``state_dtype`` (e.g. bf16) stores the momentum low-precision, the
+    ``adamw_lowmem`` trade."""
     fallback = fallback or optax.adamw(3e-4)
 
     def mom_init(params):
-        return jax.tree_util.tree_map(jnp.zeros_like, params)
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, state_dtype or p.dtype), params
+        )
 
     def mom_update(grads, mom, params=None, **_kw):
-        new_mom = jax.tree_util.tree_map(lambda m, g: momentum * m + g, mom, grads)
+        new_mom = jax.tree_util.tree_map(
+            lambda m, g: (momentum * m.astype(g.dtype) + g).astype(m.dtype), mom, grads
+        )
 
         def one(g, m):
-            eff = momentum * m + g if nesterov else m
+            eff = momentum * m.astype(g.dtype) + g if nesterov else m.astype(g.dtype)
             o = _newton_schulz(eff, ns_steps)
-            scale = jnp.sqrt(jnp.maximum(1.0, g.shape[0] / g.shape[1]))
+            # flax kernels are (fan_in, fan_out): the Muon per-matrix LR
+            # scale is sqrt(max(1, fan_out / fan_in)) = shape[1]/shape[0]
+            # (the torch recipe's rows/cols, transposed for this layout)
+            scale = jnp.sqrt(jnp.maximum(1.0, g.shape[1] / g.shape[0]))
             return (-learning_rate * scale * o).astype(g.dtype)
 
         return jax.tree_util.tree_map(one, grads, new_mom), new_mom
