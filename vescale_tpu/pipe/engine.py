@@ -26,6 +26,8 @@ forward runs once."""
 from __future__ import annotations
 
 import dataclasses
+import statistics
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -82,6 +84,10 @@ class PipeEngine:
         self.plan = plan
         self.loss_fn = loss_fn  # loss_fn(last_stage_output, target_microbatch)
         self.mesh = device_mesh
+        # optional (instruction, seconds) callback; when set, each
+        # instruction's produced value is block_until_ready'd so the wall
+        # time is the instruction's own (profiling mode — see profile_costs)
+        self.on_instruction: Optional[Callable] = None
 
     # ----------------------------------------------------------- helpers
     def _split_microbatches(self, batch, num_microbatches: int):
@@ -160,7 +166,10 @@ class PipeEngine:
                 return (g, m) in wgrad_stash
             return False
 
-        def run(ins: Instruction) -> None:
+        def run(ins: Instruction):
+            """Execute one instruction; returns EVERYTHING it produced
+            (for profiling-mode block_until_ready timing — blocking a
+            subset would let sibling outputs bleed into the next timer)."""
             g = self.module.group_index(ins.stage, ins.chunk)
             m = ins.microbatch
             if ins.kind == InstructionKind.FORWARD:
@@ -176,9 +185,9 @@ class PipeEngine:
                         if targets is not None:
                             losses[m] = self.loss_fn(y, targets[m]["target"])
                         acts[(g, m)] = y
-                    else:
-                        acts[(g, m)] = fwd(params_per_group[g], x)
-                    return
+                        return (y, losses.get(m))
+                    acts[(g, m)] = fwd(params_per_group[g], x)
+                    return acts[(g, m)]
                 if g == G - 1:
                     def f(p, xx):
                         return self.loss_fn(fwd(p, xx), targets[m]["target"])
@@ -194,6 +203,7 @@ class PipeEngine:
                 acts[(g, m)] = y
                 if g == G - 1:
                     losses[m] = y
+                return y
             elif ins.kind == InstructionKind.BACKWARD:
                 pb = pullbacks.pop((g, m))
                 dy = (
@@ -205,6 +215,7 @@ class PipeEngine:
                 if g > 0:
                     cotangents[(g - 1, m)] = dx
                 _accumulate(grads, g, dparams)
+                return (dparams, dx, grads[g])
             elif ins.kind == InstructionKind.BACKWARD_DGRAD:
                 f_lin, p, x = linears.pop((g, m))
                 dy = (
@@ -212,6 +223,7 @@ class PipeEngine:
                     if g == G - 1
                     else cotangents.pop((g, m))
                 )
+                dx = None
                 if g > 0:
                     # input-grad only: transpose the linear map in its x slot
                     # (params tangent pinned to zero — no weight-grad matmuls)
@@ -220,19 +232,32 @@ class PipeEngine:
                     (dx,) = dgrad_t(dy)
                     cotangents[(g - 1, m)] = dx
                 wgrad_stash[(g, m)] = PendingWgrad(f_lin, dy, p, x)
+                return (dx, dy)
             elif ins.kind == InstructionKind.BACKWARD_WGRAD:
-                _accumulate(grads, g, wgrad_stash.pop((g, m)).compute())
+                dp = wgrad_stash.pop((g, m)).compute()
+                _accumulate(grads, g, dp)
+                return (dp, grads[g])
+            return None
 
         # round-robin clock over stages, dependency-driven (the reference's
         # per-rank executors run concurrently; single-controller execution
         # needs only the dependency order)
+        timer = self.on_instruction
         queues = [list(s) for s in schedule]
         pos = [0] * len(queues)
         while any(p < len(q) for p, q in zip(pos, queues)):
             progressed = False
             for s, q in enumerate(queues):
                 if pos[s] < len(q) and ready(q[pos[s]]):
-                    run(q[pos[s]])
+                    ins = q[pos[s]]
+                    if timer is None:
+                        run(ins)
+                    else:
+                        # every profiled instruction is blocked, so the device
+                        # queue is empty at start: wall time == own duration
+                        t0 = time.perf_counter()
+                        jax.block_until_ready(run(ins))
+                        timer(ins, time.perf_counter() - t0)
                     pos[s] += 1
                     progressed = True
             if not progressed:
@@ -252,6 +277,51 @@ class PipeEngine:
         return self.forward_backward(
             params_per_group, minibatch, num_microbatches, forward_only=True
         )
+
+    def profile_costs(self, params_per_group, minibatch, num_microbatches=None,
+                      warmup: int = 1, comm: float = 0.0):
+        """Measured per-stage instruction durations -> ``StageCosts`` (the
+        reference CostGraph's *profiled* inputs, zero_bubble_v.py:198).
+
+        Runs ``warmup + 1`` passes of the configured schedule with each
+        instruction block_until_ready'd and wall-timed; the last pass's
+        median duration per (kind, stage) becomes the cost.  Fused BACKWARD
+        timings split evenly into bd/w.  V=1 only (cost schedules model one
+        chunk per stage)."""
+        from .schedules import StageCosts
+
+        if self.module.num_groups != self.plan.num_stages:
+            raise ValueError("profile_costs needs one group per stage (V=1)")
+        S = self.plan.num_stages
+        times: Dict[Tuple[Any, int], List[float]] = {}
+
+        def cb(ins, dt):
+            times.setdefault((ins.kind, ins.stage), []).append(dt)
+
+        old = self.on_instruction
+        self.on_instruction = cb
+        try:
+            for _ in range(warmup):
+                self.forward_backward(params_per_group, minibatch, num_microbatches)
+            times.clear()  # keep only the post-warmup (compile-cached) pass
+            self.forward_backward(params_per_group, minibatch, num_microbatches)
+        finally:
+            self.on_instruction = old
+
+        def med(kind, s, default=0.0):
+            v = times.get((kind, s))
+            return statistics.median(v) if v else default
+
+        F, B = InstructionKind.FORWARD, InstructionKind.BACKWARD
+        Bd, W = InstructionKind.BACKWARD_DGRAD, InstructionKind.BACKWARD_WGRAD
+        f = tuple(med(F, s) for s in range(S))
+        if any((Bd, s) in times for s in range(S)):
+            bd = tuple(med(Bd, s) for s in range(S))
+            w = tuple(med(W, s) for s in range(S))
+        else:  # fused-backward schedule: split the measurement evenly
+            bd = tuple(med(B, s) / 2.0 for s in range(S))
+            w = bd
+        return StageCosts(f=f, bd=bd, w=w, comm=comm)
 
     __call__ = forward_backward
 
