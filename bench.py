@@ -15,6 +15,8 @@ execution; the loss is host-fetched for true timings.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -300,9 +302,7 @@ def main():
     )
 
 
-if __name__ == "__main__":
-    import os
-
+def _dispatch():
     which = os.environ.get("VESCALE_BENCH")
     if which == "moe":
         bench_moe()
@@ -310,3 +310,124 @@ if __name__ == "__main__":
         bench_longctx()
     else:
         main()
+
+
+def _ancestor_pids() -> set:
+    """This process plus its whole parent chain (never kill those)."""
+    pids, pid = set(), os.getpid()
+    while pid > 1 and pid not in pids:
+        pids.add(pid)
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                pid = int(f.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, ValueError, IndexError):
+            break
+    return pids
+
+def _kill_stale_holders() -> None:
+    """Kill leaked bench/dryrun children from earlier driver attempts that
+    may still hold the single TPU chip (the reference's scripts/run_test.sh
+    does the same pkill hygiene between test files).  Scoped to python
+    processes whose cmdline mentions bench.py/__graft_entry__, excluding this
+    process and its ancestors (the driver's own shell matches 'bench.py')."""
+    import signal
+
+    keep = _ancestor_pids()
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit() or int(entry) in keep:
+            continue
+        try:
+            with open(f"/proc/{entry}/cmdline", "rb") as f:
+                cmd = f.read().decode("utf-8", "replace").replace("\0", " ")
+        except OSError:
+            continue
+        if "python" not in cmd:
+            continue
+        if any(pat in cmd for pat in (
+            "bench.py", "bench._dispatch", "__graft_entry__", "print(len(jax.devices()))",
+        )):
+            try:
+                os.kill(int(entry), signal.SIGKILL)
+                print(f"[bench] killed stale holder pid={entry}: {cmd[:120]}", file=sys.stderr)
+            except OSError:
+                pass
+
+
+def _probe_default_backend(timeout: float) -> int:
+    """Device count of the default backend, measured in a subprocess: a sick
+    TPU plugin blocks jax.devices() indefinitely (round-2 BENCH failure), so
+    the orchestrating parent never initializes the backend itself."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode == 0 and proc.stdout.strip():
+            return int(proc.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, ValueError, OSError):
+        pass
+    return 0
+
+
+def _run_child(deadline: float, force_cpu: bool = False) -> bool:
+    """Run the selected bench in a child process; True iff it printed the
+    JSON line.  The child (not this parent) risks backend-init hangs."""
+    env = dict(os.environ)
+    env["VESCALE_BENCH_CHILD"] = "1"
+    code = "import bench; bench._dispatch()"
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        code = "import jax; jax.config.update('jax_platforms','cpu'); " + code
+    timeout = max(60.0, deadline - time.time())
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        )
+        out, err, rc = proc.stdout, proc.stderr, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout if isinstance(e.stdout, str) else (e.stdout or b"").decode("utf-8", "replace")
+        err = e.stderr if isinstance(e.stderr, str) else (e.stderr or b"").decode("utf-8", "replace")
+        rc = 124
+    sys.stderr.write(err[-8000:] if err else "")
+    emitted = False
+    for line in (out or "").splitlines():
+        if line.startswith("{") and '"metric"' in line:
+            print(line)
+            emitted = True
+    return emitted and rc == 0
+
+
+def _orchestrate() -> int:
+    """Retry/backoff wrapper so one transient 'TPU backend UNAVAILABLE'
+    (round-2 BENCH_r02 rc=1) cannot cost the round its perf number.  Budget-
+    bounded; final fallback emits an honestly-labelled CPU line so the driver
+    always records parseable output."""
+    budget = float(os.environ.get("VESCALE_BENCH_BUDGET_S", "1200"))
+    deadline = time.time() + budget
+    cpu_reserve = 240.0  # leave room for the CPU fallback rung
+    attempt = 0
+    while time.time() < deadline - cpu_reserve:
+        attempt += 1
+        _kill_stale_holders()
+        n = _probe_default_backend(timeout=min(90.0, deadline - cpu_reserve - time.time()))
+        if n < 1:
+            print(f"[bench] attempt {attempt}: default backend unavailable; backing off",
+                  file=sys.stderr)
+            time.sleep(min(15.0 * attempt, 45.0))
+            continue
+        if _run_child(deadline - cpu_reserve):
+            return 0
+        print(f"[bench] attempt {attempt}: bench child failed; retrying", file=sys.stderr)
+        time.sleep(min(10.0 * attempt, 30.0))
+    print("[bench] TPU unavailable within budget; emitting CPU fallback line", file=sys.stderr)
+    return 0 if _run_child(deadline, force_cpu=True) else 1
+
+
+if __name__ == "__main__":
+    if os.environ.get("VESCALE_BENCH_CHILD"):
+        _dispatch()
+    else:
+        sys.exit(_orchestrate())
