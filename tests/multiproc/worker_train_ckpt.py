@@ -105,5 +105,45 @@ for k in ("W", "b"):
     d = float(maxdiff(loaded["model"][k], params[k]))
     assert d < 1e-6, (k, d)
 
+# ---- local-only load plans: a dp-sharded (process-spanning) array must
+# cost each process only ITS half of the bytes (reference
+# create_default_local_load_plan, vescale_planner.py:64)
+big = np.arange(64 * 128, dtype=np.float32).reshape(64, 128)
+big_sh = NamedSharding(mesh.jax_mesh, P("dp", None))
+big_arr = mk(big.shape, big_sh, lambda i: big[i])
+io_dir = os.path.join(ckpt_dir, "..", "ckpt_io")
+ckpt.save(io_dir, {"m": {"big": big_arr}})
+vdist.barrier("after_big_save")
+loaded_big = ckpt.load(io_dir, {"m": {"big": big_arr}})
+stats = dict(ckpt.LAST_LOAD_STATS)
+half = big.nbytes // 2
+assert half <= stats["bytes_read"] <= half + 8 * 512, (stats, half)
+for sh in loaded_big["m"]["big"].addressable_shards:
+    np.testing.assert_array_equal(np.asarray(sh.data), big[sh.index])
+
+# ---- multi-process DArray save/load: dp-sharded DArray chunks are written
+# by the process that holds them and re-loaded resharded (round-4 removal of
+# the NotImplementedError gate, checkpoint/__init__.py)
+from vescale_tpu.darray import from_local  # noqa: E402
+from vescale_tpu.placements import Replicate, Shard  # noqa: E402
+
+dval = np.arange(32 * 16, dtype=np.float32).reshape(32, 16)
+locs = [dval[16 * (r // 4): 16 * (r // 4) + 16] for r in range(8)]  # dp-split
+darr = from_local(locs, mesh, [Shard(0), Replicate()])
+da_dir = os.path.join(ckpt_dir, "..", "ckpt_darray")
+ckpt.save(da_dir, {"m": {"d": darr}})
+vdist.barrier("after_darray_save")
+if me == 0:
+    ddir = os.path.join(da_dir, "data", "m", "d")
+    files = sorted(os.listdir(ddir))
+    assert len(files) == 2, files  # 2 dp chunks, deduped across tp replicas
+# reshard on load: dp-sharded -> tp-sharded on dim 1
+tmpl = from_local([np.zeros((32, 4), np.float32)] * 8, mesh, [Replicate(), Shard(1)])
+loaded_d = ckpt.load(da_dir, {"m": {"d": tmpl}})
+stats = dict(ckpt.LAST_LOAD_STATS)
+assert stats["bytes_read"] <= dval.nbytes + 4 * 512, stats
+for sh in loaded_d["m"]["d"].data.addressable_shards:
+    np.testing.assert_array_equal(np.asarray(sh.data), dval[sh.index])
+
 vdist.barrier("done")
 print(f"OK proc {me}")

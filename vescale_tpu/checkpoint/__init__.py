@@ -33,14 +33,28 @@ import jax.numpy as jnp
 
 from ..darray import DArray, from_local
 from ..spec import DArraySpec, TensorMeta
-from .planner import SavePlanner, array_chunks, array_plan, fetch_chunk, flatten_state, key_of_path
-from .reshard import Box, dense_to_flat_ranges, intersect
+from .planner import (
+    SavePlanner,
+    _normalize_darray,
+    array_chunks,
+    array_plan,
+    fetch_chunk,
+    flatten_state,
+    key_of_path,
+)
+from .reshard import Box, box_from_index, dense_to_flat_ranges, fill_box_from_chunks, intersect
 from .storage import AsyncWriter, FileSystemStorage, MemoryStorage, Storage, bytes_to_array
 
-__all__ = ["save", "load", "CheckpointHandle", "FileSystemStorage", "MemoryStorage"]
+__all__ = ["save", "load", "CheckpointHandle", "FileSystemStorage", "MemoryStorage", "LAST_LOAD_STATS"]
 
 _PLANNER = SavePlanner()
 _MEM_STORES: Dict[str, MemoryStorage] = {}
+
+# io accounting of the most recent load() on this process — the scale
+# contract is bytes_read ~= bytes of the addressable shards, never the
+# full logical state (reference local-only load plans,
+# vescale_planner.py:64); tests assert on this
+LAST_LOAD_STATS: Dict[str, int] = {"bytes_read": 0, "files_read": 0}
 
 
 def _storage_for(path: str) -> Storage:
@@ -80,13 +94,15 @@ def _writer_process(leaf, owner, chunk_idx: int, nproc: int, proc_of: Dict[int, 
     from ..darray import DArray
 
     if isinstance(leaf, DArray):
-        # multi-process DArray saves are gated out in save(); the eager
-        # to_local fetch and the Partial-normalizing redistribute are
-        # single-controller operations that would diverge across processes
-        raise NotImplementedError(
-            "multi-process save of DArray leaves: pass the physical array "
-            "(darr.data, a sharded jax.Array) instead"
+        # owner = all flat mesh ranks holding this chunk; write from one of
+        # the processes whose devices hold it (addressable-shard fetch in
+        # planner.fetch_chunk), round-robined for load balance
+        ranks = owner if isinstance(owner, tuple) else (owner,)
+        mesh = leaf.mesh
+        procs = sorted(
+            {mesh.jax_mesh.devices[tuple(mesh.coordinate_of_rank(r))].process_index for r in ranks}
         )
+        return procs[chunk_idx % len(procs)]
     if isinstance(owner, tuple):  # jax.Array: device ids holding this chunk
         procs = sorted({proc_of[i] for i in owner if i in proc_of})
         return procs[chunk_idx % len(procs)]
@@ -116,6 +132,13 @@ def save(
 
     for top_key, tree in checkpoint_state.items():
         flat = flatten_state(tree)
+        # normalize DArray leaves ONCE up front: the Partial-reducing /
+        # interleave-collapsing redistribute is a collective program in a
+        # multi-process run, so every process must execute it exactly once
+        # per leaf in the same deterministic order
+        flat = [
+            (k, _normalize_darray(leaf) if isinstance(leaf, DArray) else leaf) for k, leaf in flat
+        ]
         # plan caching (reference lookup_plan_meta, vescale_planner.py:116):
         # the chunk layout is deterministic given the state-dict signature
         sig = _PLANNER.plan_signature(flat)
@@ -168,28 +191,99 @@ def save(
     return None
 
 
-def _assemble(entry, storage: Storage, target_leaf):
-    """Read + reshard one array for ``target_leaf``'s layout."""
+class _ChunkReader:
+    """Caching, byte-counting chunk reader.  The cache is cleared per leaf
+    (peak host memory = one leaf's addressable bytes, not the state dict's);
+    every file is read at most once per leaf even when several target shards
+    intersect it."""
+
+    def __init__(self, storage: Storage):
+        self._storage = storage
+        self._cache: Dict[str, np.ndarray] = {}
+        self.bytes_read = 0
+        self.files_read = 0
+
+    def read(self, fname: str) -> np.ndarray:
+        if fname not in self._cache:
+            data = self._storage.read_bytes(fname)
+            self.bytes_read += len(data)
+            self.files_read += 1
+            self._cache[fname] = bytes_to_array(data)
+        return self._cache[fname]
+
+    def next_leaf(self) -> None:
+        self._cache.clear()
+
+
+def _assemble_full(entry, reader: _ChunkReader) -> np.ndarray:
+    """Full logical assembly — only for host-replicated (np/scalar) targets,
+    which genuinely need every byte."""
     shape = tuple(entry["shape"])
+    saved = [(Box.from_json(c), c["file"]) for c in entry["chunks"]]
+    return fill_box_from_chunks(
+        Box((0,) * len(shape), shape), shape, np.dtype(entry["dtype"]), saved, reader.read
+    )
+
+
+def _load_darray(entry, reader: _ChunkReader, target: DArray) -> DArray:
+    """Local-only DArray load: assemble each ADDRESSABLE device's logical
+    chunk from the intersecting saved chunks and build the physical array
+    shard-by-shard — the full logical value is never materialized on any
+    host (reference create_default_local_load_plan,
+    vescale_planner.py:64)."""
+    from ..darray import _assemble_physical_fn
+
+    shape = tuple(entry["shape"])
+    if shape != tuple(target.shape):
+        raise ValueError(
+            f"shape mismatch: saved {shape} vs template {target.shape} "
+            "(resharding changes layout, not logical shape)"
+        )
+    spec = target.spec
+    lay = spec.layout()
+    if spec.has_partial() or lay.interleaves:
+        # Partial/Interleaved load templates are debug-only layouts; the
+        # full-assembly fallback keeps them working (single-controller)
+        return _relayout(_assemble_full(entry, reader), target)
     dtype = np.dtype(entry["dtype"])
+    tdtype = np.dtype(target.dtype)
     saved = [(Box.from_json(c), c["file"]) for c in entry["chunks"]]
 
-    # Assemble the FULL logical array from chunks, then lay it out as the
-    # target wants.  (Single-controller: the full value is addressable; a
-    # multi-host runtime would assemble only the local boxes — the chunk
-    # math supports it via intersect/dense_to_flat_ranges.)
-    full = np.zeros(shape, dtype)
-    flat_view = full.reshape(-1)
-    for box, fname in saved:
-        data = bytes_to_array(storage.read_bytes(fname))
-        if box.flat:
-            flat_view[box.offset[0]: box.offset[0] + box.size[0]] = data.reshape(-1)
-        elif box.size == ():
-            full[()] = data.reshape(())
+    def local_fn(r: int) -> np.ndarray:
+        coord = spec.mesh.coordinate_of_rank(r)
+        if spec.has_ragged():
+            size, off = spec.ragged_local_chunk(coord)
+            box = Box((off,), (size,), flat=True)
         else:
-            sl = tuple(slice(o, o + s) for o, s in zip(box.offset, box.size))
-            full[sl] = data.reshape(box.size)
-    return full
+            lshape, offs = spec.local_chunk(coord)
+            box = Box(tuple(offs), tuple(lshape))
+        return fill_box_from_chunks(box, shape, dtype, saved, reader.read).astype(tdtype, copy=False)
+
+    return DArray(_assemble_physical_fn(spec, local_fn, tdtype), spec)
+
+
+def _load_jax_array(entry, reader: _ChunkReader, target: jax.Array):
+    """Local-only jax.Array load via make_array_from_callback — the callback
+    assembles exactly the requested shard's box; only this process's
+    addressable shards are ever requested."""
+    from jax.sharding import NamedSharding
+
+    shape = tuple(entry["shape"])
+    if shape != tuple(target.shape):
+        raise ValueError(f"shape mismatch: saved {shape} vs template {target.shape}")
+    dtype = np.dtype(entry["dtype"])
+    tdtype = np.dtype(target.dtype)
+    saved = [(Box.from_json(c), c["file"]) for c in entry["chunks"]]
+    if not isinstance(target.sharding, NamedSharding):
+        # single-device/uncommitted leaves (e.g. step counters): full read,
+        # kept uncommitted so jit may co-locate them with the params
+        return jnp.asarray(_assemble_full(entry, reader).astype(tdtype, copy=False))
+
+    def cb(idx):
+        box = box_from_index(idx, shape)
+        return fill_box_from_chunks(box, shape, dtype, saved, reader.read).astype(tdtype, copy=False)
+
+    return jax.make_array_from_callback(shape, target.sharding, cb)
 
 
 def load(path: str, checkpoint_state: Dict[str, Any], broadcast_checkpoint: bool = False) -> Dict[str, Any]:
@@ -197,9 +291,16 @@ def load(path: str, checkpoint_state: Dict[str, Any], broadcast_checkpoint: bool
     pytree of DArray/jax.Array/np leaves — values are ignored, shardings are
     the contract).  Returns a new state dict with loaded values
     (reference load, checkpoint/__init__.py:35; online reshard per
-    README.md:37-41)."""
+    README.md:37-41).
+
+    Scale contract: for DArray / sharded jax.Array targets, each process
+    reads only the saved chunks intersecting its ADDRESSABLE shards and
+    never materializes the full logical array (see ``LAST_LOAD_STATS``)."""
     storage = _storage_for(path)
+    LAST_LOAD_STATS.update(bytes_read=0, files_read=0)  # reset: a failed
+    # load must not leave the previous load's stats looking current
     meta = json.loads(storage.read_bytes("meta.json").decode())
+    reader = _ChunkReader(storage)
     out: Dict[str, Any] = {}
     for top_key, tree in checkpoint_state.items():
         flat_with_path = jax.tree_util.tree_flatten_with_path(
@@ -211,9 +312,16 @@ def load(path: str, checkpoint_state: Dict[str, Any], broadcast_checkpoint: bool
             if full_key not in meta["arrays"]:
                 raise KeyError(f"checkpoint at {path} has no array {full_key}")
             entry = meta["arrays"][full_key]
-            full = _assemble(entry, storage, leaf)
-            leaves.append(_relayout(full, leaf))
+            if isinstance(leaf, DArray):
+                leaves.append(_load_darray(entry, reader, leaf))
+            elif isinstance(leaf, jax.Array):
+                leaves.append(_load_jax_array(entry, reader, leaf))
+            else:
+                leaves.append(_relayout(_assemble_full(entry, reader), leaf))
+            reader.next_leaf()
         out[top_key] = jax.tree_util.tree_unflatten(flat_with_path[1], leaves)
+    LAST_LOAD_STATS["bytes_read"] = reader.bytes_read
+    LAST_LOAD_STATS["files_read"] = reader.files_read
     return out
 
 

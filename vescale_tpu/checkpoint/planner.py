@@ -19,7 +19,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 import jax
 
-from .reshard import Box, chunks_for_spec, dense_to_flat_ranges, intersect
+from .reshard import Box, box_from_index, chunks_for_spec, dense_to_flat_ranges, intersect
 
 __all__ = [
     "SavePlanner",
@@ -88,14 +88,10 @@ def array_plan(leaf) -> Tuple[Tuple[int, ...], str, List[Tuple[Box, Any]]]:
         except Exception:  # uncommitted single-device leaf
             imap = {d: tuple(slice(None) for _ in leaf.shape) for d in leaf.devices()}
         for dev, idx in imap.items():
-            off = tuple(int(s.start or 0) for s in idx)
-            size = tuple(
-                int((s.stop if s.stop is not None else dim) - (s.start or 0))
-                for s, dim in zip(idx, leaf.shape)
-            )
-            if not idx:  # scalar
-                off, size = (), ()
-            seen.setdefault((off, size), []).append(int(dev.id))
+            box = box_from_index(idx, leaf.shape)
+            if box.nelems == 0:
+                continue  # over-sharded device owns an empty shard
+            seen.setdefault((box.offset, box.size), []).append(int(dev.id))
         plan = [
             (Box(off, size), tuple(sorted(ids))) for (off, size), ids in sorted(seen.items())
         ]
@@ -105,17 +101,37 @@ def array_plan(leaf) -> Tuple[Tuple[int, ...], str, List[Tuple[Box, Any]]]:
 
 
 def fetch_chunk(leaf, box: Box, owner) -> np.ndarray:
-    """D2H read of one planned chunk."""
+    """D2H read of one planned chunk.
+
+    DArray chunks are fetched from the physical array's ADDRESSABLE shards
+    whenever possible — the per-device slot layout is trimmed to the true
+    local extent (inverse of darray._assemble_physical's rank_shard), so a
+    multi-process save never touches non-addressable data (reference
+    per-rank WriteItems, vescale_planner.py:106)."""
     from ..darray import DArray
 
     if isinstance(leaf, DArray):
         leaf = _normalize_darray(leaf)
-        return np.asarray(leaf.to_local(rank=owner)).reshape(box.size)
+        ranks = owner if isinstance(owner, tuple) else (owner,)
+        spec = leaf.spec
+        shards = {s.device: s for s in getattr(leaf.data, "addressable_shards", ())}
+        for r in ranks:
+            coord = spec.mesh.coordinate_of_rank(r)
+            dev = spec.mesh.jax_mesh.devices[tuple(coord)]
+            if dev not in shards:
+                continue
+            buf = np.asarray(shards[dev].data)
+            if spec.has_ragged():
+                size, _off = spec.ragged_local_chunk(coord)
+                return buf.reshape(-1)[:size].reshape(box.size)
+            lshape, _offs = spec.local_chunk(coord)
+            return buf[tuple(slice(0, e) for e in lshape)].reshape(box.size)
+        # tracer/abstract data: fall back to the single-controller local view
+        return np.asarray(leaf.to_local(rank=ranks[0])).reshape(box.size)
     if isinstance(leaf, jax.Array):
         for sh in leaf.addressable_shards:
             idx = sh.index
-            off = tuple(int(s.start or 0) for s in idx)
-            if off == box.offset or (not idx and box.offset == ()):
+            if box_from_index(idx, leaf.shape).offset == box.offset:
                 return np.asarray(sh.data)
         raise ValueError(f"no addressable shard at {box}")
     return np.asarray(leaf)

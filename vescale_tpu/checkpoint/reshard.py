@@ -17,7 +17,18 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["Box", "intersect", "chunks_for_spec"]
+__all__ = ["Box", "intersect", "chunks_for_spec", "fill_box_from_chunks", "box_from_index"]
+
+
+def box_from_index(idx, shape: Sequence[int]) -> "Box":
+    """Dense Box from a jax sharding index (tuple of slices with possibly
+    None start/stop)."""
+    off = tuple(int(s.start or 0) for s in idx)
+    size = tuple(
+        int((s.stop if s.stop is not None else dim) - (s.start or 0))
+        for s, dim in zip(idx, shape)
+    )
+    return Box(off, size)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,6 +72,8 @@ def dense_to_flat_ranges(box: Box, shape: Sequence[int]) -> List[Tuple[int, int]
     """A dense box as a list of contiguous (start, length) runs in the
     flattened row-major space (used to intersect dense saves with ragged
     loads — the reference's _break_ragged_box)."""
+    if box.nelems == 0:
+        return []
     if box.flat:
         return [(box.offset[0], box.size[0])]
     if not shape:
@@ -94,12 +107,15 @@ def dense_to_flat_ranges(box: Box, shape: Sequence[int]) -> List[Tuple[int, int]
     return ranges
 
 
-def chunks_for_spec(spec) -> List[Tuple[Box, int]]:
-    """Unique owned chunks of a DArraySpec with their owning flat rank,
-    deduped across replicated mesh dims — the save-side WriteItems of the
-    reference planner (one mesh sweep; owner = first rank holding the box)."""
+def chunks_for_spec(spec) -> List[Tuple[Box, Tuple[int, ...]]]:
+    """Unique owned chunks of a DArraySpec with ALL flat ranks holding each
+    box, deduped across replicated mesh dims — the save-side WriteItems of
+    the reference planner (vescale_planner.py:106).  Recording every replica
+    rank lets the multi-process save load-balance chunk writes across the
+    processes that can address the data (reference dedup_plans load balance,
+    vescale_planner.py:132,137)."""
     mesh = spec.mesh
-    seen = {}
+    seen: dict = {}
     for r in range(mesh.size()):
         coord = mesh.coordinate_of_rank(r)
         if spec.has_ragged():
@@ -108,6 +124,57 @@ def chunks_for_spec(spec) -> List[Tuple[Box, int]]:
         else:
             shape, offs = spec.local_chunk(coord)
             box = Box(tuple(offs), tuple(shape))
-        if box.nelems > 0 and box not in seen:
-            seen[box] = r
-    return list(seen.items())
+        if box.nelems > 0:
+            seen.setdefault(box, []).append(r)
+    return [(box, tuple(ranks)) for box, ranks in seen.items()]
+
+
+def fill_box_from_chunks(tbox: Box, shape: Sequence[int], dtype, saved, read) -> np.ndarray:
+    """Assemble the contents of one target box from the saved chunks that
+    intersect it, reading ONLY those chunks (the reference's local-only load
+    plan, vescale_planner.py:64 create_default_local_load_plan).
+
+    ``saved`` is ``[(Box, fname), ...]``; ``read(fname)`` returns the chunk's
+    np array and is expected to cache/count reads.  Mixed flat (ragged) and
+    dense boxes are resolved in the flattened row-major space via
+    ``dense_to_flat_ranges`` — a dense box's elements in row-major order are
+    exactly the concatenation of its flat runs, so run-overlap arithmetic
+    maps source positions to target positions with no full-array buffer."""
+    out = np.zeros(tbox.size, dtype)
+    if tbox.nelems == 0:
+        return out  # over-sharded ranks own an empty shard; nothing to read
+    any_flat = tbox.flat or any(b.flat for b, _ in saved)
+    if not any_flat:
+        for box, fname in saved:
+            inter = intersect(box, tbox)
+            if inter is None:
+                continue
+            data = np.asarray(read(fname)).reshape(box.size)
+            src = tuple(slice(o - bo, o - bo + s) for o, bo, s in zip(inter.offset, box.offset, inter.size))
+            dst = tuple(slice(o - to, o - to + s) for o, to, s in zip(inter.offset, tbox.offset, inter.size))
+            out[dst] = data[src]
+        return out
+    outflat = out.reshape(-1)
+    tranges = dense_to_flat_ranges(tbox, shape)
+    tpos = np.cumsum([0] + [l for _s, l in tranges[:-1]])
+    tmin, tmax = tranges[0][0], max(ts + tl for ts, tl in tranges)
+    for box, fname in saved:
+        sranges = dense_to_flat_ranges(box, shape)
+        # cheap whole-chunk rejection before the run-pair scan
+        if not sranges or sranges[-1][0] + sranges[-1][1] <= tmin or sranges[0][0] >= tmax:
+            continue
+        data = None
+        sp = 0
+        for ss, sl in sranges:
+            if ss >= tmax:
+                break  # both run lists ascend; nothing later can overlap
+            if ss + sl > tmin:
+                for (ts, tl), tp in zip(tranges, tpos):
+                    lo, hi = max(ts, ss), min(ts + tl, ss + sl)
+                    if lo >= hi:
+                        continue
+                    if data is None:
+                        data = np.asarray(read(fname)).reshape(-1)
+                    outflat[tp + lo - ts: tp + hi - ts] = data[sp + lo - ss: sp + hi - ss]
+            sp += sl
+    return out
