@@ -31,6 +31,7 @@ class MoEBlock(nn.Module):
         return x + y
 
 
+@pytest.mark.slow
 def test_5d_train_step_and_checkpoint(tmp_path):
     """pp=2 x dp=2 x ep=2 (+ tp axis present for attention-free tp=1 compat)
     on 8 devices; blocks pipelined via ppermute with EP expert sharding auto

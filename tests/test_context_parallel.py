@@ -87,6 +87,7 @@ def test_ring_rejects_indivisible():
         ring_self_attention(q, k, v, mesh)
 
 
+@pytest.mark.slow
 def test_blockwise_causal_grads():
     """regression: causal blockwise attention must be differentiable."""
     q, k, v = _qkv(jax.random.key(7), T=40)

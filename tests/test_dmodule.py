@@ -51,6 +51,7 @@ def test_param_shardings_from_plan(mesh2d):
     assert g.sharding.shard_shape(g.shape) == g.shape
 
 
+@pytest.mark.slow
 def test_sharded_init_matches_single_device(mesh2d, mesh1d):
     model = GPT(CFG)
     dm_sharded = parallelize_module(model, mesh2d, nanogpt_plan(mesh2d))
@@ -63,6 +64,7 @@ def test_sharded_init_matches_single_device(mesh2d, mesh1d):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_forward_matches_single_device(mesh2d):
     model = GPT(CFG)
     dm = parallelize_module(model, mesh2d, nanogpt_plan(mesh2d))
@@ -73,6 +75,7 @@ def test_forward_matches_single_device(mesh2d):
     np.testing.assert_allclose(np.asarray(sharded), np.asarray(golden), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_nanogpt_e2e_loss_match(mesh2d):
     """TP+SP+DP training on 8 virtual devices must track the single-device
     loss curve (fp32) — the reference's headline correctness claim."""
@@ -113,6 +116,7 @@ def test_nanogpt_e2e_loss_match(mesh2d):
     assert losses_g[-1] < losses_g[0]
 
 
+@pytest.mark.slow
 def test_dropout_bitwise_deterministic(mesh2d):
     """Distributed dropout mask == single-device mask (the feature the
     reference patched CUDA philox for)."""
@@ -127,6 +131,7 @@ def test_dropout_bitwise_deterministic(mesh2d):
     np.testing.assert_allclose(np.asarray(out_sharded), np.asarray(out_single), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_grad_accumulation_matches_full_batch(mesh2d):
     """k micro-batches accumulated == one full batch (linear loss mean)."""
     model = GPT(CFG)
@@ -146,6 +151,7 @@ def test_grad_accumulation_matches_full_batch(mesh2d):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_vedevicemesh_nanogpt_e2e():
     """nanoGPT through the global VeDeviceMesh singleton (reference
     legacy/test/parallel/devicemesh_api/test_nano_gpt.py)."""

@@ -107,6 +107,7 @@ def test_flop_balance(net):
     assert max(costs) < 4 * min(costs), costs
 
 
+@pytest.mark.slow
 def test_engine_runs_autosplit_grads_match(net):
     """PipeEngine (1F1B) on an auto-split graph matches jax.grad of the
     un-split model — the reference's pp accuracy-alignment test shape

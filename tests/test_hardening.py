@@ -76,6 +76,7 @@ def test_rng_bitwise_after_redistribute():
 
 
 # ------------------------------------------------------ uneven shards in jit
+@pytest.mark.slow
 def test_uneven_batch_and_seq_inside_jit(mesh2d):
     """Batch/seq sizes NOT divisible by the mesh dims run correctly under
     jit with the full TP/SP plan (GSPMD pads internally)."""
@@ -111,6 +112,7 @@ def test_uneven_redistribute_inside_jit():
     [(jnp.float32, 5e-5), (jnp.bfloat16, 1.5e-2)],
     ids=["fp32", "bf16"],
 )
+@pytest.mark.slow
 def test_tp_sp_loss_parity_tiered(mesh2d, dtype, rtol):
     """Golden-parity at both precisions with tiered tolerances (reference
     bar: negligible fp32, ~1% bf16 — nanogpt_4D_finetune/README.md:38)."""

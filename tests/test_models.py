@@ -60,6 +60,7 @@ def test_llama_tp_sp_matches_single(mesh2d):
     np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_llama_trains(mesh2d):
     import optax
     from vescale_tpu.train import make_train_step
@@ -80,6 +81,7 @@ def test_llama_trains(mesh2d):
     assert losses[-1] < losses[0]  # overfits one batch
 
 
+@pytest.mark.slow
 def test_mixtral_ep_matches_single():
     mesh = vt.DeviceMesh(("dp", "ep"), (2, 4))
     model = Mixtral(TINY_MIXTRAL)
@@ -93,6 +95,7 @@ def test_mixtral_ep_matches_single():
     np.testing.assert_allclose(np.asarray(out), np.asarray(golden), rtol=3e-5, atol=3e-5)
 
 
+@pytest.mark.slow
 def test_mixtral_trains_with_aux_loss():
     import optax
 
@@ -153,6 +156,58 @@ def test_llama_scan_layers_matches_loop():
     np.testing.assert_allclose(np.asarray(out_remat), np.asarray(out_scan), rtol=1e-6)
 
 
+@pytest.mark.slow
+def test_llama_scan_remat_mlp_grad_parity():
+    """The longctx bench config (scan_layers + remat_scope='mlp') must have
+    the same LOSS AND GRADIENTS as the plain loop model — covers the 32k
+    rung's backward numerics before it is ever the headline (ADVICE r2;
+    VERDICT r3 next #9)."""
+    import dataclasses
+
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
+
+    loop_cfg = TINY_LLAMA
+    bench_cfg = dataclasses.replace(
+        TINY_LLAMA, scan_layers=True, remat=True, remat_scope="mlp"
+    )
+    toks = jax.random.randint(jax.random.key(3), (2, 17), 0, TINY_LLAMA.vocab_size)
+    idx, tgt = toks[:, :-1], toks[:, 1:]
+    loop_params = Llama(loop_cfg).init(jax.random.key(0), idx)["params"]
+    per_layer = [loop_params[f"layers_{i}"] for i in range(loop_cfg.num_hidden_layers)]
+    stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *per_layer)
+    scan_params = {k: v for k, v in loop_params.items() if not k.startswith("layers_")}
+    scan_params["layers"] = {"block": stacked}
+
+    def loss_of(cfg, params):
+        return lambda p: cross_entropy_loss(Llama(cfg).apply({"params": p}, idx), tgt)
+
+    l_loop, g_loop = jax.value_and_grad(loss_of(loop_cfg, loop_params))(loop_params)
+    l_scan, g_scan = jax.value_and_grad(loss_of(bench_cfg, scan_params))(scan_params)
+    np.testing.assert_allclose(float(l_scan), float(l_loop), rtol=1e-6)
+    # re-stack the loop grads into the scanned layout and compare leaf-wise
+    g_stacked = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls), *[g_loop[f"layers_{i}"] for i in range(loop_cfg.num_hidden_layers)]
+    )
+    for (kp, a), (_kp, b) in zip(
+        jax.tree_util.tree_flatten_with_path(g_scan["layers"]["block"])[0],
+        jax.tree_util.tree_flatten_with_path(g_stacked)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6, err_msg=str(kp)
+        )
+    for k in g_scan:
+        if k == "layers":
+            continue
+        for (kp, a), (_kp, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g_scan[k])[0],
+            jax.tree_util.tree_flatten_with_path(g_loop[k])[0],
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6, err_msg=f"{k}:{kp}"
+            )
+
+
+@pytest.mark.slow
 def test_llama_scanned_plan_shards_stack(mesh2d):
     """llama_plan(scanned=True) shifts block tp-shards past the (L,) stack
     axis; parallelize_module on the scanned model lands tp on the right dim."""
@@ -189,6 +244,7 @@ def test_llama_remat_policy_without_remat_raises():
         dataclasses.replace(TINY_LLAMA, remat_policy="dots_saveable")
 
 
+@pytest.mark.slow
 def test_llama_remat_scope_mlp_matches():
     """remat_scope='mlp' (attention residuals live, MLP rematerialized) is a
     pure scheduling choice: loss and grads bitwise-match remat_scope='block'

@@ -49,6 +49,7 @@ def _naive_moe(params, x2, cfg):
     return y
 
 
+@pytest.mark.slow
 def test_moe_layer_matches_naive():
     layer = MoEMLP(CFG)
     x = jax.random.normal(jax.random.key(0), (2, 8, CFG.d_model))
@@ -176,6 +177,7 @@ def test_capacity_ceil():
     assert TokenDispatcher.capacity_for(10, 8, 2, 1.0) == 3
 
 
+@pytest.mark.slow
 def test_load_aware_reallocation_under_training_loop():
     """VERDICT r1 next #9: an EMA of routed-token counts (sown by MoEMLP)
     drives BasicExpertsAllocator mid-run; params AND adam state migrate via

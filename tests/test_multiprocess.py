@@ -12,12 +12,15 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_train_and_checkpoint(tmp_path):
     repo = pathlib.Path(__file__).resolve().parent.parent
     worker = repo / "tests" / "multiproc" / "worker_train_ckpt.py"

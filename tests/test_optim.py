@@ -56,6 +56,7 @@ def _golden_run(model, steps=3, tx=None):
     return losses, params
 
 
+@pytest.mark.slow
 def test_distributed_optimizer_zero2_matches_golden(mesh2d):
     model = GPT(CFG)
     dm = parallelize_module(model, mesh2d, nanogpt_plan(mesh2d))
@@ -85,6 +86,59 @@ def test_distributed_optimizer_zero2_matches_golden(mesh2d):
     mu = state["inner"][0].mu
     leaf = jax.tree_util.tree_leaves(mu)[1]
     assert "dp" in str(leaf.sharding.spec), leaf.sharding.spec
+
+
+def test_found_inf_skip_step_and_dynamic_scale(mesh1d):
+    """VERDICT r3 next #5: a grad with an inf leaves params and opt-state
+    bitwise unchanged and decrements the dynamic loss scale; clean steps
+    grow the scale after growth_interval (reference
+    found_inf_reduce_handler, vescale/dtensor/_dispatch.py:60)."""
+    from jax.sharding import PartitionSpec as P
+
+    params = {"w": jnp.arange(16.0, dtype=jnp.float32).reshape(4, 4)}
+    dopt = DistributedOptimizer(
+        optax.adamw(1e-2),
+        mesh1d,
+        {"w": P()},
+        dp_dims=("tp",),
+        loss_scale="dynamic",
+        init_scale=1024.0,
+        growth_interval=2,
+    )
+    state = jax.jit(dopt.init)(params)
+    assert float(state["loss_scale"]["scale"]) == 1024.0
+
+    step = jax.jit(dopt.step)
+    good = {"w": jnp.ones((4, 4), jnp.float32) * 1024.0}  # pre-scaled grads
+    bad = {"w": good["w"].at[1, 2].set(jnp.inf)}
+
+    # overflow: bitwise no-op on params + inner state, scale backs off
+    p1, s1 = step(params, state, bad)
+    np.testing.assert_array_equal(np.asarray(p1["w"]), np.asarray(params["w"]))
+    for a, b in zip(jax.tree_util.tree_leaves(s1["inner"]), jax.tree_util.tree_leaves(state["inner"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(s1["main_params"]["w"]), np.asarray(state["main_params"]["w"]))
+    assert float(s1["loss_scale"]["scale"]) == 512.0
+    assert int(s1["loss_scale"]["growth_count"]) == 0
+
+    # clean steps: params move; after growth_interval=2 the scale doubles
+    p2, s2 = step(p1, s1, good)
+    assert not np.allclose(np.asarray(p2["w"]), np.asarray(p1["w"]))
+    assert float(s2["loss_scale"]["scale"]) == 512.0
+    assert int(s2["loss_scale"]["growth_count"]) == 1
+    p3, s3 = step(p2, s2, good)
+    assert float(s3["loss_scale"]["scale"]) == 1024.0
+    assert int(s3["loss_scale"]["growth_count"]) == 0
+
+    # scale_loss helper uses the live scale
+    assert float(dopt.scale_loss(jnp.asarray(2.0), s3)) == 2048.0
+
+    # nan is caught too, and static-scale mode also skips
+    dopt_static = DistributedOptimizer(optax.sgd(1e-2), mesh1d, {"w": P()}, dp_dims=("tp",), loss_scale=8.0)
+    st = jax.jit(dopt_static.init)(params)
+    pn, stn = jax.jit(dopt_static.step)(params, st, {"w": good["w"].at[0, 0].set(jnp.nan)})
+    np.testing.assert_array_equal(np.asarray(pn["w"]), np.asarray(params["w"]))
+    assert "loss_scale" not in stn  # static scale carries no state
 
 
 def test_basic_optimizer_and_clip(mesh1d):

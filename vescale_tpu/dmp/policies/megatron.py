@@ -8,6 +8,19 @@ kernel as column- or row-parallel by Megatron naming conventions and pairing
 within a block: projections INTO the hidden bottleneck are rows, expansions
 are columns.  Falls back to replicate when unsure — always correct, just
 not sharded.
+
+Forward plans are derived PER MODULE from the same tree (reference
+per-module providers, legacy/vescale/dmp/policies/megatron.py:33-218:
+mlp/attention in/out, LayerNorm SP regions):
+  - a module with both column- and row-parallel child projections is a TP
+    region (attention/mlp) -> inputs/outputs batch-sharded (gather the seq
+    dim at the region boundary);
+  - a norm module that is a SIBLING of a TP region is a Megatron-SP norm ->
+    inputs/outputs additionally seq-sharded over tp;
+  - a top-level norm (the final norm) runs SP in, batch-sharded out;
+  - the root reshards inputs/outputs batch-sharded over dp.
+(The reference also plans dropout modules for RNG alignment; flax dropout
+is parameterless and our threefry-partitionable RNG needs no plan.)
 """
 
 from __future__ import annotations
@@ -34,8 +47,18 @@ def _path_str(kp) -> str:
     return ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
 
 
+def _parent(path: str) -> str:
+    return path.rsplit(".", 1)[0] if "." in path else ""
+
+
 @register_policy("MEGATRON")
-def megatron_policy(abstract_params, mesh, tp_dim: str = "tp", dp_dim: str = "dp") -> Dict[str, Any]:
+def megatron_policy(
+    abstract_params,
+    mesh,
+    tp_dim: str = "tp",
+    dp_dim: str = "dp",
+    sequence_parallel: bool = True,
+) -> Dict[str, Any]:
     """Derive {parameter, forward} plans from param names/shapes."""
     names = mesh.mesh_dim_names
     tp_i = names.index(tp_dim) if tp_dim in names else None
@@ -48,13 +71,19 @@ def megatron_policy(abstract_params, mesh, tp_dim: str = "tp", dp_dim: str = "dp
         return out
 
     param_plan: Dict[str, Any] = {}
+    col_parents: set = set()   # module paths owning a column-parallel kernel
+    row_parents: set = set()   # ... a row-parallel kernel
+    norm_modules: set = set()  # module paths of norm layers
 
     def classify(kp, leaf):
         path = _path_str(kp)
         low = path.lower()
         key = re.escape(path)
         shape = tuple(leaf.shape)
+        mod = _parent(path)
         if any(h in low for h in _NORM_HINTS) or len(shape) == 0:
+            if any(h in mod.lower().rsplit(".", 1)[-1] for h in _NORM_HINTS):
+                norm_modules.add(mod)
             param_plan[key] = pl()
             return leaf
         if low.endswith(".embedding") or any(h in low for h in _HEAD_HINTS):
@@ -69,9 +98,11 @@ def megatron_policy(abstract_params, mesh, tp_dim: str = "tp", dp_dim: str = "dp
             parent = low.rsplit(".", 2)[-2] if "." in low else low
             if any(h in parent for h in _COL_HINTS) and shape[1 + off] % n_tp == 0:
                 param_plan[key] = pl(1 + off)
+                col_parents.add(_parent(mod))
                 return leaf
             if any(h in parent for h in _ROW_HINTS) and shape[0 + off] % n_tp == 0:
                 param_plan[key] = pl(0 + off)
+                row_parents.add(_parent(mod))
                 return leaf
             param_plan[key] = pl()
             return leaf
@@ -86,9 +117,36 @@ def megatron_policy(abstract_params, mesh, tp_dim: str = "tp", dp_dim: str = "dp
         return leaf
 
     jax.tree_util.tree_map_with_path(classify, abstract_params)
+
+    # ---------------- per-module forward plan (reference megatron.py:33-218)
     dp_i = names.index(dp_dim) if dp_dim in names else None
-    root_in = [Replicate()] * mesh.ndim
-    if dp_i is not None:
-        root_in[dp_i] = Shard(0)
-    fwd_plan = {r"": {"input": [root_in], "output": [root_in]}}
+
+    def act(seq: bool = False):
+        out: List[Any] = [Replicate()] * mesh.ndim
+        if dp_i is not None:
+            out[dp_i] = Shard(0)  # batch dim
+        if seq and tp_i is not None:
+            out[tp_i] = Shard(1)  # Megatron-SP: sequence dim over tp
+        return out
+
+    dp_only = act()
+    seq_par = act(seq=sequence_parallel)
+    # TP regions: a module (not the root) holding BOTH column- and
+    # row-parallel projections — the attention / mlp "enter replicated,
+    # leave partial" blocks of the reference providers
+    regions = {m for m in (col_parents & row_parents) if m}
+    region_parents = {_parent(m) for m in regions}
+    fwd_plan: Dict[str, Any] = {r"": {"input": [dp_only], "output": [dp_only]}}
+    for m in sorted(regions):
+        fwd_plan[re.escape(m)] = {"input": [dp_only], "output": [dp_only]}
+    for m in sorted(norm_modules):
+        par = _parent(m)
+        if par in regions or m in regions:
+            continue  # q/k-norms inside attention: the region boundary rules
+        if par in region_parents and par != "":
+            # block norm, sibling of a TP region -> SP in/out
+            fwd_plan[re.escape(m)] = {"input": [seq_par], "output": [seq_par]}
+        elif par == "" and regions:
+            # final norm: SP in, gathered (batch-only) out for the head
+            fwd_plan[re.escape(m)] = {"input": [seq_par], "output": [dp_only]}
     return {"parameter": param_plan, "forward": fwd_plan}

@@ -36,9 +36,26 @@ __all__ = [
     "DistributedOptimizer",
     "zero_sharded",
     "clip_grad_norm_fp32",
+    "found_inf",
     "muon",
     "adamw_lowmem",
 ]
+
+
+def found_inf(grads) -> jax.Array:
+    """Scalar bool: any non-finite value in any grad leaf (reference
+    found_inf_reduce_handler, vescale/dtensor/_dispatch.py:60 — there an
+    explicit cross-rank all-reduce of per-shard flags; under GSPMD the
+    ``jnp.any`` over sharded leaves compiles to the same reduce +
+    all-reduce)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    flags = [jnp.any(~jnp.isfinite(g)) for g in leaves if hasattr(g, "dtype")]
+    if not flags:
+        return jnp.asarray(False)
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
 
 
 # --------------------------------------------------------------------- util
@@ -180,6 +197,15 @@ class DistributedOptimizer:
 
     Grad reduce-scatter / param all-gather / overlap are emitted by XLA from
     the sharding constraints (see module docstring).
+
+    Overflow protection (reference found_inf_reduce_handler,
+    vescale/dtensor/_dispatch.py:60, + the overflow tracking of
+    legacy/vescale/optim/distributed_optimizer.py): with
+    ``loss_scale="dynamic"`` (or a static float) the step unscales grads,
+    all-reduces a found-inf flag, and on overflow SKIPS the step — params
+    and optimizer state come back bitwise unchanged — backing off the
+    dynamic scale; after ``growth_interval`` clean steps the scale doubles.
+    Scale the loss with ``dopt.scale_loss(loss, state)`` before ``grad``.
     """
 
     def __init__(
@@ -192,6 +218,12 @@ class DistributedOptimizer:
         grad_clip: Optional[float] = None,
         main_param_dtype=jnp.float32,
         overlap_param_gather: bool = True,  # parity flag; XLA handles overlap
+        loss_scale=None,  # None | float | "dynamic"
+        init_scale: float = 2.0**15,
+        growth_interval: int = 2000,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        skip_nonfinite: Optional[bool] = None,
         **_: Any,
     ):
         self.mesh = mesh
@@ -199,6 +231,20 @@ class DistributedOptimizer:
         self.param_pspecs = param_pspecs
         self.grad_clip = grad_clip
         self.main_param_dtype = main_param_dtype
+        self.loss_scale = loss_scale
+        self.init_scale = float(init_scale)
+        self.growth_interval = int(growth_interval)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        # skip-step on non-finite grads is implied by loss scaling; it can
+        # also be enabled standalone (bf16-without-scaling runs)
+        self.skip_nonfinite = bool(loss_scale is not None) if skip_nonfinite is None else skip_nonfinite
+        if loss_scale == "dynamic" and not self.skip_nonfinite:
+            raise ValueError(
+                "loss_scale='dynamic' requires skip_nonfinite: the scale "
+                "backoff/growth is driven by the overflow flag — without it "
+                "the scale would freeze and overflows would corrupt params"
+            )
         self.tx = (
             zero_sharded(optimizer, mesh, param_pspecs, dp_dims)
             if mesh is not None and param_pspecs is not None
@@ -210,20 +256,75 @@ class DistributedOptimizer:
         main = jax.tree_util.tree_map(lambda p: p.astype(self.main_param_dtype), params)
         if self.mesh is not None and self.param_pspecs is not None:
             main = _constrain_state(main, params, self.param_pspecs, self.mesh, self.dp_dims)
-        return {"inner": self.tx.init(main), "main_params": main}
+        state = {"inner": self.tx.init(main), "main_params": main}
+        if self.loss_scale == "dynamic":
+            state["loss_scale"] = {
+                "scale": jnp.asarray(self.init_scale, jnp.float32),
+                "growth_count": jnp.asarray(0, jnp.int32),
+            }
+        return state
+
+    # ------------------------------------------------------- loss scaling
+    def current_scale(self, opt_state):
+        if self.loss_scale == "dynamic":
+            return opt_state["loss_scale"]["scale"]
+        if self.loss_scale is not None:
+            return jnp.asarray(self.loss_scale, jnp.float32)
+        return jnp.asarray(1.0, jnp.float32)
+
+    def scale_loss(self, loss, opt_state):
+        """Multiply the loss by the current scale (call before ``grad``)."""
+        return loss * self.current_scale(opt_state).astype(loss.dtype)
 
     # -------------------------------------------------------------- step
     def step(self, params, opt_state, grads):
-        """copy grads -> fp32, clip, inner step on fp32 master shards,
-        copy master -> model params (reference step/:1142-1223 pipeline)."""
-        grads32 = jax.tree_util.tree_map(lambda g: g.astype(self.main_param_dtype), grads)
+        """copy grads -> fp32, unscale, clip, inner step on fp32 master
+        shards, copy master -> model params (reference step/:1142-1223
+        pipeline); overflow -> skip + scale backoff."""
+        inv = 1.0 / self.current_scale(opt_state)
+        grads32 = jax.tree_util.tree_map(
+            lambda g: g.astype(self.main_param_dtype) * inv.astype(self.main_param_dtype), grads
+        )
+        # the overflow flag is computed on the raw unscaled grads, BEFORE
+        # clipping turns inf into nan-laden scale factors
+        overflow = found_inf(grads32) if self.skip_nonfinite else None
         if self.grad_clip is not None:
             grads32, _ = clip_grad_norm_fp32(grads32, self.grad_clip)
         main = opt_state["main_params"]
         updates, inner = self.tx.update(grads32, opt_state["inner"], main)
-        main = optax.apply_updates(main, updates)
-        new_params = jax.tree_util.tree_map(lambda m, p: m.astype(p.dtype), main, params)
-        return new_params, {"inner": inner, "main_params": main}
+        main_new = optax.apply_updates(main, updates)
+        if overflow is None:
+            new_params = jax.tree_util.tree_map(lambda m, p: m.astype(p.dtype), main_new, params)
+            out_state = {"inner": inner, "main_params": main_new}
+            if "loss_scale" in opt_state:
+                out_state["loss_scale"] = opt_state["loss_scale"]
+            return new_params, out_state
+
+        def keep_old(new, old):
+            return jax.tree_util.tree_map(lambda n, o: jnp.where(overflow, o, n), new, old)
+
+        main_out = keep_old(main_new, main)
+        inner_out = keep_old(inner, opt_state["inner"])
+        new_params = keep_old(
+            jax.tree_util.tree_map(lambda m, p: m.astype(p.dtype), main_new, params), params
+        )
+        out_state = {"inner": inner_out, "main_params": main_out}
+        if self.loss_scale == "dynamic":
+            ls = opt_state["loss_scale"]
+            growth = jnp.where(overflow, 0, ls["growth_count"] + 1)
+            grown = growth >= self.growth_interval
+            scale = jnp.where(
+                overflow,
+                ls["scale"] * self.backoff_factor,
+                jnp.where(grown, ls["scale"] * self.growth_factor, ls["scale"]),
+            )
+            out_state["loss_scale"] = {
+                "scale": scale,
+                "growth_count": jnp.where(grown, 0, growth).astype(jnp.int32),
+            }
+        elif "loss_scale" in opt_state:
+            out_state["loss_scale"] = opt_state["loss_scale"]
+        return new_params, out_state
 
     def state_pspecs(self, params):
         """PartitionSpecs of the optimizer state (metadata only — used by

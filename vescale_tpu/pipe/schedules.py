@@ -193,9 +193,11 @@ class StageCosts:
 
     def __post_init__(self):
         # frozen dataclass doubles as the schedule-cache key: coerce
-        # sequence fields so list-built instances stay hashable
+        # sequence fields so list-built instances stay hashable, and comm
+        # so np scalars hash/compare like the equal python float
         for name in ("f", "bd", "w"):
             object.__setattr__(self, name, tuple(float(x) for x in getattr(self, name)))
+        object.__setattr__(self, "comm", float(self.comm))
 
     @staticmethod
     def uniform(num_stages: int, f: float = 1.0, bd: float = 1.0,
@@ -222,41 +224,53 @@ class StageCosts:
 
 
 def _dep_key(ins: Instruction):
-    return (ins.kind, ins.stage, ins.microbatch)
+    return (ins.kind, ins.stage, ins.microbatch, ins.chunk)
 
 
-def _deps(ins: Instruction, num_stages: int) -> List[Tuple[InstructionKind, int, int]]:
-    """Predecessor completion events of ``ins`` (V=1 dependency graph — the
-    edges of the reference CostGraph)."""
+def _deps(ins: Instruction, num_stages: int, num_chunks: int = 1) -> List[Tuple]:
+    """Predecessor completion events of ``ins`` — the edges of the reference
+    CostGraph (zero_bubble_v.py:198), generalized to V virtual chunks: chunk
+    ``v`` of stage ``s`` is virtual stage ``v*S + s`` (Megatron VPP order,
+    matching PipeModule.group_index).  Forward flows up the virtual-stage
+    chain (wrapping S-1 -> 0 into the next chunk); the cotangent flows back
+    down it."""
     F, B = InstructionKind.FORWARD, InstructionKind.BACKWARD
     Bd, W = InstructionKind.BACKWARD_DGRAD, InstructionKind.BACKWARD_WGRAD
-    s, m = ins.stage, ins.microbatch
+    s, m, v = ins.stage, ins.microbatch, ins.chunk
     if ins.kind == F:
-        return [(F, s - 1, m)] if s > 0 else []
+        if s > 0:
+            return [(F, s - 1, m, v)]
+        if v > 0:
+            return [(F, num_stages - 1, m, v - 1)]  # chunk wrap-around hop
+        return []
     if ins.kind in (B, Bd):
-        deps = [(F, s, m)]
+        deps: List[Tuple] = [(F, s, m, v)]
         if s < num_stages - 1:
-            # the downstream stage produces our cotangent with its dgrad
-            # (or fused backward — whichever that stage's schedule uses)
-            deps.append(("cot", s + 1, m))
+            # the downstream virtual stage produces our cotangent with its
+            # dgrad (or fused backward — whichever its schedule uses)
+            deps.append(("cot", s + 1, m, v))
+        elif v < num_chunks - 1:
+            deps.append(("cot", 0, m, v + 1))  # wrap: next chunk, stage 0
         return deps
     if ins.kind == W:
-        return [(Bd, s, m)]
+        return [(Bd, s, m, v)]
     return []
 
 
-def _ready_time(ins: Instruction, done: dict, num_stages: int, costs: StageCosts) -> Optional[float]:
+def _ready_time(
+    ins: Instruction, done: dict, num_stages: int, costs: StageCosts, num_chunks: int = 1
+) -> Optional[float]:
     """Earliest start of ``ins`` given completion times ``done`` — the ONE
     encoding of the dependency/hop rules, shared by the simulator and the
     greedy generator so their cost models can never drift apart.  None if a
     predecessor hasn't completed."""
     t = 0.0
-    for dep in _deps(ins, num_stages):
+    for dep in _deps(ins, num_stages, num_chunks):
         if dep[0] == "cot":
-            _, ds, dm = dep
-            key = (InstructionKind.BACKWARD_DGRAD, ds, dm)
+            _, ds, dm, dv = dep
+            key = (InstructionKind.BACKWARD_DGRAD, ds, dm, dv)
             if key not in done:
-                key = (InstructionKind.BACKWARD, ds, dm)
+                key = (InstructionKind.BACKWARD, ds, dm, dv)
             if key not in done:
                 return None
             t = max(t, done[key] + costs.comm)
@@ -268,29 +282,29 @@ def _ready_time(ins: Instruction, done: dict, num_stages: int, costs: StageCosts
     return t
 
 
+def _num_chunks_of(schedule: List[List[Instruction]]) -> int:
+    return 1 + max((i.chunk for stage_ins in schedule for i in stage_ins), default=0)
+
+
 def simulate_schedule(
     schedule: List[List[Instruction]],
     costs: StageCosts,
 ) -> float:
     """Event-driven makespan of a per-stage instruction schedule under the
     cost model: stages execute their lists in order (each stage is a serial
-    resource), cross-stage edges add ``costs.comm``.  Virtual chunks are not
-    modeled (the compiled spmd.py path owns interleaving).  Returns the time
-    the last instruction completes."""
+    resource), cross-stage edges add ``costs.comm``; virtual chunks follow
+    the VPP virtual-stage chain (chunk costs = hosting stage's costs).
+    Returns the time the last instruction completes."""
     S = len(schedule)
     if len(costs.f) != S or len(costs.bd) != S or len(costs.w) != S:
         raise ValueError(
             f"StageCosts for {len(costs.f)} stages used with a {S}-stage schedule"
         )
     done: dict = {}
+    V = _num_chunks_of(schedule)
 
     def ready_at(ins: Instruction) -> Optional[float]:
-        return _ready_time(ins, done, S, costs)
-
-    for stage_ins in schedule:
-        for ins in stage_ins:
-            if ins.chunk:
-                raise NotImplementedError("simulate_schedule models V=1 only")
+        return _ready_time(ins, done, S, costs, V)
 
     stage_time = [0.0] * S
     pos = [0] * S
@@ -320,51 +334,79 @@ def _zb_greedy_schedule(
     num_stages: int,
     num_microbatches: int,
     costs: StageCosts,
+    virtual_chunks: int = 1,
 ) -> List[List[Instruction]]:
     """Global-clock greedy over the ZB dependency graph: repeatedly start the
     schedulable instruction with the earliest feasible start time, preferring
     dgrad > forward > wgrad on ties — W work naturally slots into gaps whose
     length the cost model exposes (the reference generator's rollout,
-    zero_bubble_v.py:602).
+    zero_bubble_v.py:602).  With ``virtual_chunks`` > 1 each stage's F/Bd/W
+    streams exist per chunk and dependencies follow the VPP virtual-stage
+    chain (the reference CostGraph's virtual chunks, zero_bubble_v.py:198).
 
-    Memory bound: stage ``s`` may hold at most ``S - s`` (the 1F1B/ZB-H1
-    warmup depth) forwards whose WGRAD hasn't run.  The engine pins each
-    forward's linearization residuals until BACKWARD_WGRAD pops them
-    (engine.py wgrad_stash), so the bound must count F minus W — not F minus
-    Bd — or the rollout trades O(M) residual memory for makespan the way the
+    Memory bound: the engine pins each forward's linearization residuals
+    until BACKWARD_WGRAD pops them (engine.py wgrad_stash), so stage ``s``
+    may hold at most ``(V-1)*S + 2*(S-s) - 1`` forwards whose WGRAD hasn't
+    run — the effective residual depth of the fixed-defer ZB-H1 heuristic
+    (its in-flight F-Bd depth ``S-s`` plus its W deferral ``S-s-1``),
+    extended by the VPP warmup term.  A tighter cap starves the warmup and
+    deadlocks V>1; a looser one trades O(M) memory for makespan the way the
     reference's memory-limited CostGraph deliberately does not."""
-    S, M = num_stages, num_microbatches
+    S, M, V = num_stages, num_microbatches, virtual_chunks
     F, Bd, W = InstructionKind.FORWARD, InstructionKind.BACKWARD_DGRAD, InstructionKind.BACKWARD_WGRAD
     prio = {Bd: 0, F: 1, W: 2}
     done: dict = {}
     stage_time = [0.0] * S
     schedule: List[List[Instruction]] = [[] for _ in range(S)]
-    fptr, bptr, wptr = [0] * S, [0] * S, [0] * S
-    cap = [max(1, S - s) for s in range(S)]
+    bptr = [[0] * V for _ in range(S)]
+    wptr = [[0] * V for _ in range(S)]
+    cap = [max(1, (V - 1) * S + 2 * (S - s) - 1) for s in range(S)]
+
+    # Forwards issue in the canonical Megatron wave order (chunks cycle in
+    # groups of min(S, M) microbatches — the same order the interleaved
+    # generator uses).  A free F order lets the rollout burn a stage's whole
+    # residual cap on chunk-0 forwards while every backward transitively
+    # waits on the LAST chunk's forward (no W can free the cap) — deadlock.
+    # Pinning the F order keeps the rollout deadlock-free; the cost model
+    # still owns the placement of every Bd and W.
+    group = min(S, M)
+    fwd_order: List[Tuple[int, int]] = []
+    m0 = 0
+    while len(fwd_order) < M * V:
+        for v in range(V):
+            for m in range(m0, min(m0 + group, M)):
+                fwd_order.append((m, v))
+        m0 += group
+    fnext = [0] * S
+    fcount = [0] * S
 
     def candidates(s):
         out = []
         nxt = []
-        if fptr[s] < M and fptr[s] - wptr[s] < cap[s]:
-            nxt.append(Instruction(F, s, fptr[s]))
-        if bptr[s] < M:
-            nxt.append(Instruction(Bd, s, bptr[s]))
-        if wptr[s] < bptr[s]:  # wgrad ready once its dgrad has run
-            nxt.append(Instruction(W, s, wptr[s]))
+        live = fcount[s] - sum(wptr[s])
+        if fnext[s] < M * V and live < cap[s]:
+            m, v = fwd_order[fnext[s]]
+            nxt.append(Instruction(F, s, m, v))
+        for v in range(V):
+            if bptr[s][v] < M:
+                nxt.append(Instruction(Bd, s, bptr[s][v], v))
+            if wptr[s][v] < bptr[s][v]:  # wgrad ready once its dgrad has run
+                nxt.append(Instruction(W, s, wptr[s][v], v))
         for ins in nxt:
-            rdy = _ready_time(ins, done, S, costs)
+            rdy = _ready_time(ins, done, S, costs, V)
             if rdy is not None:
                 out.append((ins, rdy))
         return out
 
-    total = 3 * M * S
+    total = 3 * M * S * V
     scheduled = 0
     while scheduled < total:
         best = None
         for s in range(S):
             for ins, rdy in candidates(s):
                 start = max(stage_time[s], rdy)
-                key = (start, prio[ins.kind], s)
+                # chunk in the tie-break keeps the rollout deterministic
+                key = (start, prio[ins.kind], s, ins.chunk)
                 if best is None or key < best[0]:
                     best = (key, ins, start)
         if best is None:
@@ -376,20 +418,28 @@ def _zb_greedy_schedule(
         stage_time[s] = end
         schedule[s].append(ins)
         if ins.kind == F:
-            fptr[s] += 1
+            fnext[s] += 1
+            fcount[s] += 1
         elif ins.kind == Bd:
-            bptr[s] += 1
+            bptr[s][ins.chunk] += 1
         else:
-            wptr[s] += 1
+            wptr[s][ins.chunk] += 1
         scheduled += 1
     return schedule
 
 
 @functools.lru_cache(maxsize=256)
-def _zb_cost_schedule_cached(num_stages: int, num_microbatches: int, costs: StageCosts):
+def _zb_cost_schedule_cached(
+    num_stages: int, num_microbatches: int, costs: StageCosts, virtual_chunks: int = 1
+):
+    if virtual_chunks > 1:
+        # interleaved 1F1B (fused B) is the V>1 heuristic baseline
+        heuristic = interleaved_1f1b_schedule(num_stages, num_microbatches, virtual_chunks)
+    else:
+        heuristic = zero_bubble_schedule(num_stages, num_microbatches)
     cands = [
-        zero_bubble_schedule(num_stages, num_microbatches),
-        _zb_greedy_schedule(num_stages, num_microbatches, costs),
+        heuristic,
+        _zb_greedy_schedule(num_stages, num_microbatches, costs, virtual_chunks),
     ]
     return min(cands, key=lambda sch: simulate_schedule(sch, costs))
 
@@ -398,16 +448,18 @@ def zero_bubble_cost_schedule(
     num_stages: int,
     num_microbatches: int,
     costs: Union[StageCosts, Sequence[float], None] = None,
+    virtual_chunks: int = 1,
 ) -> List[List[Instruction]]:
     """Cost-aware zero-bubble schedule (reference CostGraph generator,
-    zero_bubble_v.py:198,602): generate candidate schedules — the fixed-defer
-    ZB-H1 heuristic and a cost-model greedy rollout — simulate each under the
-    cost model, and return the one with the smallest makespan.
+    zero_bubble_v.py:198,602): generate candidate schedules — a fixed
+    heuristic (ZB-H1 defer for V=1, interleaved 1F1B for V>1) and a
+    cost-model greedy rollout — simulate each under the cost model, and
+    return the one with the smallest makespan.
 
     ``costs``: a ``StageCosts``, a per-stage weight sequence (param/FLOP
     counts — 1:1:1 F:Bd:W assumed), or None (uniform).  Results are memoized
-    per (S, M, costs): a training loop re-building its schedule every step
-    pays the Python rollout once."""
+    per (S, M, costs, V): a training loop re-building its schedule every
+    step pays the Python rollout once."""
     if costs is None:
         costs = StageCosts.uniform(num_stages)
     elif not isinstance(costs, StageCosts):
@@ -416,7 +468,7 @@ def zero_bubble_cost_schedule(
         raise ValueError(
             f"schedule_costs has {len(costs.f)} stages, plan has {num_stages}"
         )
-    cached = _zb_cost_schedule_cached(num_stages, num_microbatches, costs)
+    cached = _zb_cost_schedule_cached(num_stages, num_microbatches, costs, virtual_chunks)
     return [list(stage) for stage in cached]  # callers may mutate their copy
 
 
@@ -456,7 +508,10 @@ def build_schedule(
         return interleaved_1f1b_schedule(plan.num_stages, num_microbatches, plan.virtual_chunks)
     if st == PipelineScheduleType.ZERO_BUBBLE:
         costs = costs if costs is not None else plan.schedule_costs
-        if costs is not None:
-            return zero_bubble_cost_schedule(plan.num_stages, num_microbatches, costs)
+        V = max(1, plan.virtual_chunks or 1)
+        if costs is not None or V > 1:
+            # V>1 always routes through the cost generator (uniform costs if
+            # none given) — the fixed-defer heuristic is V=1-only
+            return zero_bubble_cost_schedule(plan.num_stages, num_microbatches, costs, V)
         return zero_bubble_schedule(plan.num_stages, num_microbatches)
     raise NotImplementedError(f"schedule {st}")

@@ -63,10 +63,13 @@ def redistribute(darr: DArray, placements, mesh: Optional[DeviceMesh] = None) ->
 
     # Per-shard transition kernels (transfer.py): each rank touches only its
     # shard; the collective is the exact reference-table op (all-gather /
-    # reduce-scatter / all-to-all / ...) — no logical-size allocation.
-    from .transfer import fallback_fn, transition_fn
+    # reduce-scatter / all-to-all / all-gather-v / all-to-all-v) — no
+    # logical-size allocation.
+    from .transfer import fallback_fn, ragged_transition_fn, transition_fn
 
     fn = transition_fn(src, dst)
+    if fn is None and (src.has_ragged() or dst.has_ragged()):
+        fn = ragged_transition_fn(src, dst)
     if fn is not None:
         return DArray(fn(darr.data), dst)
 
