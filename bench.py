@@ -332,14 +332,22 @@ def _register_holder() -> None:
     """Every bench child/probe writes a pidfile on start (removed at exit);
     only REGISTERED pids are ever killed — a concurrently running legitimate
     job (the judge's bench, a parallel dryrun) is untouchable (ADVICE r3
-    medium: the cmdline-pattern SIGKILL could hit it)."""
+    medium: the cmdline-pattern SIGKILL could hit it).
+
+    Invariant: bench children register ONLY when their orchestrator holds
+    the cleanup flock (VESCALE_BENCH_NO_REGISTER is set otherwise).  A
+    lock-holding orchestrator can therefore kill every 'bench:' registrant
+    outside its ancestry: the registrant's own orchestrator held the lock
+    when it spawned and must be dead now, or we could not hold it."""
     import atexit
 
+    if os.environ.get("VESCALE_BENCH_NO_REGISTER"):
+        return
     os.makedirs(HOLDERS_DIR, exist_ok=True)
     path = os.path.join(HOLDERS_DIR, str(os.getpid()))
     try:
         with open(path, "w") as f:
-            f.write(str(time.time()))
+            f.write(f"bench:{time.time()}")
     except OSError:
         return
     atexit.register(lambda: os.path.exists(path) and os.remove(path))
@@ -393,6 +401,16 @@ def _kill_stale_holders() -> None:
                 os.remove(path)
             except OSError:
                 pass
+            continue
+        # 'graft:' registrants (driver probe children, __graft_entry__.py)
+        # register unconditionally and may be LIVE under another driver:
+        # reap those only well past the probe's 45s timeout
+        try:
+            kind = open(path).read().split(":", 1)[0]
+            age = time.time() - os.path.getmtime(path)
+        except OSError:
+            continue
+        if kind == "graft" and age < 300.0:
             continue
         if "python" in cmd:  # pid-reuse guard: only kill if it's still python
             try:
@@ -475,6 +493,9 @@ def _orchestrate() -> int:
     cpu_reserve = 240.0  # leave room for the CPU fallback rung
     have_lock = _acquire_orchestrator_lock()
     if not have_lock:
+        # no cleanup rights AND our children must not register (the live
+        # lock holder would treat them as stale-by-invariant and kill them)
+        os.environ["VESCALE_BENCH_NO_REGISTER"] = "1"
         print("[bench] another orchestrator is live; skipping stale-holder "
               "cleanup", file=sys.stderr)
     attempt = 0

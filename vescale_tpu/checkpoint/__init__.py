@@ -60,6 +60,18 @@ LAST_LOAD_STATS: Dict[str, int] = {"bytes_read": 0, "files_read": 0}
 def _storage_for(path: str) -> Storage:
     if path.startswith("mem://"):
         return _MEM_STORES.setdefault(path, MemoryStorage())
+    if path.startswith("memsvr://"):
+        # detached memory server (reference detached_mem_server.py):
+        # memsvr://<server-name>/<checkpoint-prefix>
+        from .mem_server import RemoteMemoryStorage
+
+        rest = path[len("memsvr://"):]
+        name, _, prefix = rest.partition("/")
+        key = f"memsvr://{name}/{prefix}"
+        store = _MEM_STORES.get(key)
+        if store is None:
+            store = _MEM_STORES[key] = RemoteMemoryStorage(name, prefix)
+        return store
     return FileSystemStorage(path)
 
 
@@ -358,3 +370,10 @@ def _relayout(full: np.ndarray, target_leaf):
     if np.isscalar(target_leaf) or (hasattr(target_leaf, "ndim") and target_leaf.ndim == 0):
         return arr.reshape(()).item() if not hasattr(target_leaf, "dtype") else arr.reshape(())
     return arr
+
+
+# step-indexed save/rotate/resume wrapper (reference VeScaleCheckpointer);
+# imported last — manager.py imports save/load/CheckpointHandle from here
+from .manager import CheckpointManager  # noqa: E402
+
+__all__.append("CheckpointManager")

@@ -1,0 +1,120 @@
+"""CheckpointManager — step-indexed save/rotate/resume.
+
+Capability parity with the reference VeScaleCheckpointer
+(legacy/vescale/checkpoint/api/vescale_checkpointer.py:71): the trainer-facing
+wrapper that names checkpoints by step, keeps the last K, and on restart
+finds the newest COMMITTED one (a dir whose ``meta.json`` commit marker
+exists — a torn save from a crashed run is invisible, __init__.py commit
+protocol).  The MegaScale-style recovery loop (checkpoint/README.md:49):
+
+    mgr = CheckpointManager("gs-or-fs/ckpts", keep=3)
+    step = mgr.latest_step()
+    state = mgr.restore({"model": tmpl, "optimizer": opt_tmpl}) if step else init()
+    for i in count(step or 0):
+        ...train...
+        if i % 1000 == 0:
+            mgr.save(i, {"model": params, "optimizer": opt}, async_checkpoint=True)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from . import CheckpointHandle, load, save
+
+__all__ = ["CheckpointManager"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class CheckpointManager:
+    def __init__(self, root: str, keep: int = 3):
+        if root.startswith(("mem://", "memsvr://")):
+            raise ValueError(
+                "CheckpointManager rotates directories; use a filesystem root "
+                "(memory stores are flat namespaces — save to them directly)"
+            )
+        self.root = root
+        self.keep = int(keep)
+        os.makedirs(root, exist_ok=True)
+
+    # ------------------------------------------------------------- paths
+    def step_path(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{step:010d}")
+
+    def _committed_steps(self) -> List[int]:
+        out = []
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return out
+        for e in entries:
+            m = _STEP_RE.match(e)
+            if m and os.path.exists(os.path.join(self.root, e, "meta.json")):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        """Newest step with a COMMITTED checkpoint (meta.json present);
+        None if nothing is restorable."""
+        steps = self._committed_steps()
+        return steps[-1] if steps else None
+
+    # -------------------------------------------------------------- save
+    def save(
+        self,
+        step: int,
+        checkpoint_state: Dict[str, Any],
+        async_checkpoint: bool = False,
+    ) -> Optional[CheckpointHandle]:
+        """Save under ``root/step_<N>/`` and prune old committed steps down
+        to ``keep`` (rotation runs on process 0 after the save commits)."""
+        handle = save(self.step_path(step), checkpoint_state, async_checkpoint=async_checkpoint)
+
+        def _rotate():
+            if jax.process_index() != 0:
+                return
+            # saving step N makes any committed step > N a STALE FUTURE
+            # (the run was resumed from an older step and diverged): prune
+            # those first, or the oldest-first cut below could delete the
+            # checkpoint just saved while keeping the stale ones — and the
+            # next crash-resume would restore the pre-rollback state
+            steps = [s for s in self._committed_steps() if s != step]
+            for s in steps:
+                if s > step:
+                    shutil.rmtree(self.step_path(s), ignore_errors=True)
+            steps = [s for s in steps if s < step] + [step]
+            for s in steps[: max(0, len(steps) - self.keep)]:
+                shutil.rmtree(self.step_path(s), ignore_errors=True)
+
+        if handle is None:
+            _rotate()
+            return None
+        # async: rotate at commit time, chained on the caller's wait()
+        orig_commit = handle._commit
+
+        def commit_then_rotate():
+            if orig_commit is not None:
+                orig_commit()
+            _rotate()
+
+        # single-process async saves commit meta.json on the io pool (which
+        # wait() drains first), so rotating inside the wait()-time commit
+        # hook is correct in both modes
+        handle._commit = commit_then_rotate
+        return handle
+
+    # ----------------------------------------------------------- restore
+    def restore(self, checkpoint_state: Dict[str, Any], step: Optional[int] = None) -> Dict[str, Any]:
+        """Load the given (default: latest committed) step into the
+        template's layout — the reshard-on-load path of ``load``."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no committed checkpoint under {self.root}")
+        return load(self.step_path(step), checkpoint_state)
