@@ -353,7 +353,8 @@ def _register_holder() -> None:
     atexit.register(lambda: os.path.exists(path) and os.remove(path))
 
 
-_LOCK_FH = None
+_LOCK_FH = None      # keeps the fd (and thus the flock) alive for the process
+_HAVE_LOCK = False   # True ONLY if the flock was actually acquired
 
 
 def _acquire_orchestrator_lock() -> bool:
@@ -362,16 +363,17 @@ def _acquire_orchestrator_lock() -> bool:
     lock held, any registered holder pid outside our ancestry belongs to a
     CRASHED run (a live concurrent orchestrator would hold the lock and we
     would not), so the collateral-kill scenario is structurally excluded."""
-    global _LOCK_FH
+    global _LOCK_FH, _HAVE_LOCK
     import fcntl
 
     os.makedirs(HOLDERS_DIR, exist_ok=True)
     _LOCK_FH = open(os.path.join(HOLDERS_DIR, "orchestrator.lock"), "w")
     try:
         fcntl.flock(_LOCK_FH, fcntl.LOCK_EX | fcntl.LOCK_NB)
-        return True
+        _HAVE_LOCK = True
     except OSError:
-        return False
+        _HAVE_LOCK = False
+    return _HAVE_LOCK
 
 
 def _kill_stale_holders() -> None:
@@ -383,7 +385,7 @@ def _kill_stale_holders() -> None:
     concurrent orchestrator owns those children — do not touch them)."""
     import signal
 
-    if _LOCK_FH is None or not os.path.isdir(HOLDERS_DIR):
+    if not _HAVE_LOCK or not os.path.isdir(HOLDERS_DIR):
         return
     keep = _ancestor_pids()
     for entry in os.listdir(HOLDERS_DIR):

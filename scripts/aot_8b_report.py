@@ -1,12 +1,14 @@
 """AOT multi-chip perf evidence without multi-chip hardware (round 4,
 VERDICT r3 next #2).
 
-Compiles the FULL Llama-3-8B 4D (pp x dp x tp) training step — DModule
-plans, compiled ppermute pipeline, ZeRO-sharded optimizer — against a
-virtual 32-device topology (2 x 2 x 8, a v5p-32 slice shape) at seq 4096,
-entirely ahead-of-time: parameters exist only as ShapeDtypeStructs, so the
-8B model never materializes.  From the partitioned, optimized HLO it
-reports:
+Compiles a FULL multi-dimensional training step — DModule plans, compiled
+ppermute pipeline, ZeRO-sharded optimizer, vocab-parallel loss — against a
+virtual 32-device topology at seq 4096, entirely ahead-of-time: parameters
+exist only as ShapeDtypeStructs, so the model never materializes.  Rungs
+(VESCALE_AOT_MODEL): ``8b`` Llama-3-8B pp2 x dp4 x tp4 (default), ``70b``
+Llama-3-70B pp4 x dp2 x tp4, ``mixtral`` Mixtral-8x7B pp2 x dp2 x ep4 x tp2
+(expert-parallel all-to-all included in the roofline).  From the
+partitioned, optimized HLO it reports:
 
   MEASURED (from the compiled executable):
     - collective census: op counts per type in the optimized module
@@ -38,11 +40,29 @@ import subprocess
 import sys
 import time
 
+# Model rung: VESCALE_AOT_MODEL=8b (default) | 70b | mixtral.  All compile
+# against a 32-virtual-device topology; 70b uses a deeper pp split, mixtral
+# adds an ep mesh dim (the BASELINE.md ladder's 70B 4D and Mixtral EP rungs).
+MODEL = os.environ.get("VESCALE_AOT_MODEL", "8b")
+if MODEL not in ("8b", "70b", "mixtral"):
+    raise SystemExit(
+        f"VESCALE_AOT_MODEL={MODEL!r}: expected one of 8b | 70b | mixtral "
+        "(an unknown value would compile the 8b config but label the report "
+        "with the wrong rung)"
+    )
 N_DEVICES = 32
-PP, DP, TP = 2, 4, 4  # realistic 8B 4D split: tp within a host, dp scales
+EP = 1
+if MODEL == "70b":
+    PP, DP, TP = 4, 2, 4
+    PER_DP_BATCH = 2
+elif MODEL == "mixtral":
+    PP, DP, EP, TP = 2, 2, 4, 2  # 5D-style: pp x dp x ep x tp
+    PER_DP_BATCH = 2
+else:
+    PP, DP, TP = 2, 4, 4  # realistic 8B 4D split: tp within a host, dp scales
+    PER_DP_BATCH = 2
 SEQ = 4096
 MICROBATCHES = 2
-PER_DP_BATCH = 2  # sequences per dp rank
 
 # ---- documented v5p roofline constants (jax-ml.github.io/scaling-book)
 V5P_BF16_FLOPS = 459e12          # per-chip peak, bf16
@@ -91,7 +111,10 @@ def main():
     from vescale_tpu.parallel.optimizer import zero_sharded
     from vescale_tpu.pipe.spmd import pipeline_blocks
 
-    mesh = DeviceMesh(("pp", "dp", "tp"), (PP, DP, TP), devices=jax.devices()[:N_DEVICES])
+    if MODEL == "mixtral":
+        mesh = DeviceMesh(("pp", "dp", "ep", "tp"), (PP, DP, EP, TP), devices=jax.devices()[:N_DEVICES])
+    else:
+        mesh = DeviceMesh(("pp", "dp", "tp"), (PP, DP, TP), devices=jax.devices()[:N_DEVICES])
 
     # Llama-3-8B (BASELINE.md ladder rung): GQA 32/8, hidden 4096, inter
     # 14336, vocab 128256, 32 layers.  Flash attention off: the pallas
@@ -103,26 +126,69 @@ def main():
     # collective structure is dtype-independent and the roofline uses bf16
     # byte counts, but MEASURED per-device memory below is the fp32 figure
     # (bf16 params/grads/activations halve their share of it).
-    cfg = LlamaConfig(
-        vocab_size=128256,
-        hidden_size=4096,
-        intermediate_size=14336,
-        num_hidden_layers=32,
-        num_attention_heads=32,
-        num_key_value_heads=8,
-        max_position_embeddings=SEQ,
-        rope_theta=500000.0,
-        use_flash_attention=False,
-        remat=True,
-        dtype=jnp.float32,
-    )
+    moe_cfg = None
+    if MODEL == "mixtral":
+        from vescale_tpu.models.mixtral import MixtralBlock, MixtralConfig
+
+        moe_cfg = MixtralConfig(
+            vocab_size=32000,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_hidden_layers=32,
+            num_attention_heads=32,
+            num_key_value_heads=8,
+            num_local_experts=8,
+            num_experts_per_tok=2,
+            capacity_factor=2.0,
+            max_position_embeddings=SEQ,
+            dtype=jnp.float32,
+        )
+        cfg = moe_cfg.as_llama()
+        cfg = __import__("dataclasses").replace(
+            cfg, use_flash_attention=False, dtype=jnp.float32
+        )
+    elif MODEL == "70b":
+        cfg = LlamaConfig(
+            vocab_size=128256,
+            hidden_size=8192,
+            intermediate_size=28672,
+            num_hidden_layers=80,
+            num_attention_heads=64,
+            num_key_value_heads=8,
+            max_position_embeddings=SEQ,
+            rope_theta=500000.0,
+            use_flash_attention=False,
+            remat=True,
+            dtype=jnp.float32,
+        )
+    else:
+        cfg = LlamaConfig(
+            vocab_size=128256,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_hidden_layers=32,
+            num_attention_heads=32,
+            num_key_value_heads=8,
+            max_position_embeddings=SEQ,
+            rope_theta=500000.0,
+            use_flash_attention=False,
+            remat=True,
+            dtype=jnp.float32,
+        )
     layers_per_stage = cfg.num_hidden_layers // PP
     B = DP * PER_DP_BATCH
     T = SEQ
 
     embed_dm = parallelize_module(LlamaEmbed(cfg), mesh, llama_plan(mesh), validate_plan=False)
     head_dm = parallelize_module(LlamaHead(cfg), mesh, llama_plan(mesh), validate_plan=False)
-    block_dm = parallelize_module(LlamaBlock(cfg), mesh, llama_plan(mesh), validate_plan=False)
+    if MODEL == "mixtral":
+        from vescale_tpu.models.mixtral import MixtralBlock, mixtral_plan
+
+        block_mod = MixtralBlock(moe_cfg)
+        block_dm = parallelize_module(block_mod, mesh, mixtral_plan(mesh), validate_plan=False)
+    else:
+        block_mod = LlamaBlock(cfg)
+        block_dm = parallelize_module(block_mod, mesh, llama_plan(mesh), validate_plan=False)
 
     # ---- abstract (never-materialized) parameters, born with shardings
     idx_sd = jax.ShapeDtypeStruct((B, T), jnp.int32)
@@ -143,7 +209,7 @@ def main():
     )["params"]
 
     blk_abstract = jax.eval_shape(
-        lambda x, p: LlamaBlock(cfg).init(jax.random.key(0), x, p), x_sd, pos_sd
+        lambda x, p: block_mod.init(jax.random.key(0), x, p), x_sd, pos_sd
     )["params"]
 
     def stack_block_leaf(path, leaf):
@@ -151,7 +217,9 @@ def main():
         shape = (PP, layers_per_stage) + tuple(leaf.shape)
         spec = [None, None] + [None] * len(leaf.shape)
         spec[0] = "pp"
-        if name.endswith("kernel"):
+        if any(h in name for h in ("w_in", "w_out", "b_in", "b_out")):
+            spec[2] = "ep"  # expert dim of MoE leaves (E, ...)
+        elif name.endswith("kernel"):
             if any(h in name for h in ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj")):
                 spec[3] = "tp"  # column-parallel (in, out/tp)
             elif any(h in name for h in ("o_proj", "down_proj")):
@@ -178,6 +246,13 @@ def main():
 
         @jax.checkpoint
         def one_layer(x, layer_params):
+            if MODEL == "mixtral":
+                # MixtralBlock sows the router aux loss; drop it in the AOT
+                # profile (the aux term adds no collectives of its own)
+                out, _aux = block_dm.apply(
+                    {"params": layer_params}, x, pos, mutable=["losses"]
+                )
+                return out
             return block_dm.apply({"params": layer_params}, x, pos)
 
         out, _ = jax.lax.scan(lambda x, lp: (one_layer(x, lp), None), xm, stage_params)
@@ -234,9 +309,24 @@ def main():
     )
 
     # ---------------- modeled: v5p roofline
-    n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params_sd))
+    def leaf_params(match=None):
+        total = 0
+        for kp, l in jax.tree_util.tree_flatten_with_path(params_sd)[0]:
+            name = ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp).lower()
+            if match is None or any(h in name for h in match):
+                total += int(np.prod(l.shape))
+        return total
+
+    n_params = leaf_params()
     tokens = B * T
-    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_hidden_layers * T * cfg.hidden_size
+    if MODEL == "mixtral":
+        # only top_k of num_local_experts expert FFNs run per token
+        expert_params = leaf_params(("w_in", "w_out", "b_in", "b_out"))
+        frac = moe_cfg.num_experts_per_tok / moe_cfg.num_local_experts
+        active_params = n_params - expert_params * (1.0 - frac)
+    else:
+        active_params = n_params
+    flops_per_token = 6.0 * active_params + 12.0 * cfg.num_hidden_layers * T * cfg.hidden_size
     model_flops = flops_per_token * tokens
     compute_s = model_flops / N_DEVICES / V5P_BF16_FLOPS
 
@@ -249,8 +339,15 @@ def main():
     # PP: one (mb_tokens, E) ppermute per microbatch per stage boundary, fwd+bwd
     pp_s = 2 * MICROBATCHES * (PP - 1) * (mb_tokens * E * 2) / V5P_ICI_AXIS_BW
     # DP/ZeRO: reduce-scatter grads + all-gather params, fp32-ish mixed; ~4P bytes
-    dp_s = 4.0 * n_params / PP / TP * (DP - 1) / DP / V5P_ICI_AXIS_BW
-    comm_s = tp_s + pp_s + dp_s
+    dp_s = 4.0 * n_params / PP / TP / max(1, EP) * (DP - 1) / DP / V5P_ICI_AXIS_BW
+    # EP: token dispatch + combine all-to-alls per MoE layer, fwd+bwd -> x4
+    ep_s = 0.0
+    if MODEL == "mixtral":
+        ep_bytes_per_layer = (
+            mb_tokens * moe_cfg.num_experts_per_tok * E * 2 * (EP - 1) / EP
+        )
+        ep_s = 4 * L * MICROBATCHES * ep_bytes_per_layer / V5P_ICI_AXIS_BW
+    comm_s = tp_s + pp_s + dp_s + ep_s
 
     step_overlap = max(compute_s, comm_s)
     step_serial = compute_s + comm_s
@@ -259,9 +356,10 @@ def main():
 
     report = {
         "config": {
-            "model": "llama3-8b",
+            "model": "mixtral-8x7b" if MODEL == "mixtral" else f"llama3-{MODEL}",
             "n_params": n_params,
-            "mesh": {"pp": PP, "dp": DP, "tp": TP},
+            "active_params": int(active_params),
+            "mesh": {"pp": PP, "dp": DP, "tp": TP, **({"ep": EP} if EP > 1 else {})},
             "seq_len": SEQ,
             "global_batch": B,
             "microbatches": MICROBATCHES,
@@ -277,13 +375,23 @@ def main():
             "per_device_bytes_fp32_compile": per_device_bytes,
             "per_device_gb_fp32_compile": round(per_device_bytes / 2**30, 2),
             "fits_v5p_hbm": per_device_bytes < V5P_HBM_GB * 2**30,
+            **(
+                {
+                    "topology_note": "32-virtual-chip structural check; the "
+                    "ladder's EP rung targets v5p-64+ where per-device bytes "
+                    "halve (and bf16 halves the param/grad share again)"
+                }
+                if MODEL == "mixtral"
+                else {}
+            ),
         },
         "modeled_v5p_roofline": {
             "peak_bf16_flops_per_chip": V5P_BF16_FLOPS,
             "ici_axis_bytes_per_s": V5P_ICI_AXIS_BW,
             "model_flops_per_step": model_flops,
             "compute_seconds": round(compute_s, 4),
-            "comm_seconds": {"tp": round(tp_s, 4), "pp": round(pp_s, 4), "dp": round(dp_s, 4)},
+            "comm_seconds": {"tp": round(tp_s, 4), "pp": round(pp_s, 4), "dp": round(dp_s, 4),
+                             "ep": round(ep_s, 4)},
             "step_seconds_perfect_overlap": round(step_overlap, 4),
             "step_seconds_no_overlap": round(step_serial, 4),
             "mfu_predicted_range": [round(mfu_lo, 3), round(mfu_hi, 3)],
@@ -294,7 +402,7 @@ def main():
         },
     }
     out_path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                            "AOT_8B_REPORT.json")
+                            f"AOT_{MODEL.upper()}_REPORT.json")
     with open(out_path, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report))

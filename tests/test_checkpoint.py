@@ -360,6 +360,30 @@ def test_checkpoint_manager_rotate_and_resume(tmp_path, mesh1d):
         CheckpointManager("mem://nope")
 
 
+def test_checkpoint_manager_fire_and_forget_rotation(tmp_path, mesh1d):
+    """regression: the documented recovery loop never wait()s its async
+    saves — rotation must still fire once the commit marker lands (watcher
+    thread), or the dir grows unboundedly and stale futures survive."""
+    import os
+    import time
+
+    from vescale_tpu.checkpoint.manager import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ff"), keep=1)
+    x = np.arange(8, dtype=np.float32)
+    for step in (1, 2, 3):
+        mgr.save(step, {"m": {"x": vt.distribute_tensor(x + step, mesh1d, [Shard(0)])}},
+                 async_checkpoint=True)  # handle dropped on purpose
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if (mgr.latest_step() == 3 and not os.path.exists(mgr.step_path(1))
+                and not os.path.exists(mgr.step_path(2))):
+            break
+        time.sleep(0.2)
+    assert mgr.latest_step() == 3
+    assert not os.path.exists(mgr.step_path(1)) and not os.path.exists(mgr.step_path(2))
+
+
 def test_checkpoint_manager_rollback_prunes_stale_futures(tmp_path, mesh1d):
     """regression: after resuming from an OLDER step, saving must not delete
     the new checkpoint while keeping stale future steps — steps newer than

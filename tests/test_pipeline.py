@@ -649,3 +649,15 @@ def test_profile_costs_measures_stages():
         ref_b = statistics.median(raw[(InstructionKind.BACKWARD, s)])
         assert costs_f.bd[s] + costs_f.w[s] < 50 * ref_b
         assert ref_b < 50 * (costs_f.bd[s] + costs_f.w[s])
+
+    # host-overhead calibration (ADVICE r2): subtracting the decimated-batch
+    # baseline keeps costs positive and never above the raw measurement
+    costs_c = engine.profile_costs(params, batch, num_microbatches=4,
+                                   calibrate_host_overhead=True)
+    raw_costs = engine.profile_costs(params, batch, num_microbatches=4)
+    for s in range(4):
+        assert costs_c.f[s] > 0
+        # calibrated <= ~raw (timing noise allows small excursions)
+        assert costs_c.f[s] <= raw_costs.f[s] * 3
+    sched_c = zero_bubble_cost_schedule(4, 4, costs_c)
+    _schedule_well_formed(sched_c, 4, 4, zb=True)

@@ -190,6 +190,9 @@ def save(
             for f in data_futures:
                 f.result()
             _commit()
+            # fire-and-forget callers never wait(): release the io threads
+            # (wait=False — a worker cannot join its own pool)
+            writer.pool.shutdown(wait=False)
 
         writer.futures = writer.futures + [writer.pool.submit(_finalize)]
         handle = CheckpointHandle(writer)

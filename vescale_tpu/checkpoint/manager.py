@@ -107,6 +107,26 @@ class CheckpointManager:
         # wait() drains first), so rotating inside the wait()-time commit
         # hook is correct in both modes
         handle._commit = commit_then_rotate
+        if jax.process_count() == 1:
+            # the documented recovery loop fire-and-forgets async saves
+            # (single-process saves are durable without wait()): rotation
+            # must still happen — a watcher thread rotates once the commit
+            # marker lands.  (Racing a caller that DOES wait() is fine:
+            # rotation is idempotent rmtree(ignore_errors).)
+            import threading
+            import time as _time
+
+            marker = os.path.join(self.step_path(step), "meta.json")
+
+            def _watch():
+                deadline = _time.time() + 3600.0
+                while _time.time() < deadline:
+                    if os.path.exists(marker):
+                        _rotate()
+                        return
+                    _time.sleep(0.2)
+
+            threading.Thread(target=_watch, daemon=True).start()
         return handle
 
     # ----------------------------------------------------------- restore

@@ -278,21 +278,9 @@ class PipeEngine:
             params_per_group, minibatch, num_microbatches, forward_only=True
         )
 
-    def profile_costs(self, params_per_group, minibatch, num_microbatches=None,
-                      warmup: int = 1, comm: float = 0.0):
-        """Measured per-stage instruction durations -> ``StageCosts`` (the
-        reference CostGraph's *profiled* inputs, zero_bubble_v.py:198).
-
-        Runs ``warmup + 1`` passes of the configured schedule with each
-        instruction block_until_ready'd and wall-timed; the last pass's
-        median duration per (kind, stage) becomes the cost.  Fused BACKWARD
-        timings split evenly into bd/w.  V=1 only (cost schedules model one
-        chunk per stage)."""
-        from .schedules import StageCosts
-
-        if self.module.num_groups != self.plan.num_stages:
-            raise ValueError("profile_costs needs one group per stage (V=1)")
-        S = self.plan.num_stages
+    def _timed_pass(self, params_per_group, minibatch, num_microbatches, warmup: int):
+        """One wall-timed schedule pass (after ``warmup`` untimed passes);
+        returns {(kind, stage): [durations]}."""
         times: Dict[Tuple[Any, int], List[float]] = {}
 
         def cb(ins, dt):
@@ -307,19 +295,62 @@ class PipeEngine:
             self.forward_backward(params_per_group, minibatch, num_microbatches)
         finally:
             self.on_instruction = old
+        return times
 
-        def med(kind, s, default=0.0):
-            v = times.get((kind, s))
+    def profile_costs(self, params_per_group, minibatch, num_microbatches=None,
+                      warmup: int = 1, comm: float = 0.0,
+                      calibrate_host_overhead: bool = False):
+        """Measured per-stage instruction durations -> ``StageCosts`` (the
+        reference CostGraph's *profiled* inputs, zero_bubble_v.py:198).
+
+        Runs ``warmup + 1`` passes of the configured schedule with each
+        instruction block_until_ready'd and wall-timed; the last pass's
+        median duration per (kind, stage) becomes the cost.  Fused BACKWARD
+        timings split evenly into bd/w.  V=1 only (cost schedules model one
+        chunk per stage).
+
+        ``calibrate_host_overhead``: each eager instruction pays a
+        per-call host cost (jax.linearize / vjp re-trace, dict bookkeeping)
+        that is roughly SIZE-INDEPENDENT, while the device work scales with
+        the microbatch — so raw wall times flatten the stage ratios the
+        scheduler cares about (ADVICE r2).  Calibration re-profiles on a
+        sequence-decimated copy of the minibatch and subtracts the
+        per-(kind, stage) medians: what remains is the size-scaling
+        (device) component.  Costs are clamped at a tenth of the raw
+        measurement so a noisy calibration can never zero a stage out."""
+        from .schedules import StageCosts
+
+        if self.module.num_groups != self.plan.num_stages:
+            raise ValueError("profile_costs needs one group per stage (V=1)")
+        S = self.plan.num_stages
+        times = self._timed_pass(params_per_group, minibatch, num_microbatches, warmup)
+
+        base: Dict[Tuple[Any, int], List[float]] = {}
+        if calibrate_host_overhead:
+            tiny = {
+                k: (v[:, :8] if hasattr(v, "ndim") and v.ndim >= 2 and v.shape[1] > 8 else v)
+                for k, v in minibatch.items()
+            }
+            base = self._timed_pass(params_per_group, tiny, num_microbatches, warmup)
+
+        def med(table, kind, s, default=0.0):
+            v = table.get((kind, s))
             return statistics.median(v) if v else default
+
+        def cost(kind, s):
+            raw = med(times, kind, s)
+            if not calibrate_host_overhead:
+                return raw
+            return max(raw - med(base, kind, s), raw * 0.1)
 
         F, B = InstructionKind.FORWARD, InstructionKind.BACKWARD
         Bd, W = InstructionKind.BACKWARD_DGRAD, InstructionKind.BACKWARD_WGRAD
-        f = tuple(med(F, s) for s in range(S))
+        f = tuple(cost(F, s) for s in range(S))
         if any((Bd, s) in times for s in range(S)):
-            bd = tuple(med(Bd, s) for s in range(S))
-            w = tuple(med(W, s) for s in range(S))
+            bd = tuple(cost(Bd, s) for s in range(S))
+            w = tuple(cost(W, s) for s in range(S))
         else:  # fused-backward schedule: split the measurement evenly
-            bd = tuple(med(B, s) / 2.0 for s in range(S))
+            bd = tuple(cost(B, s) / 2.0 for s in range(S))
             w = bd
         return StageCosts(f=f, bd=bd, w=w, comm=comm)
 
