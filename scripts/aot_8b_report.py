@@ -5,9 +5,10 @@ Compiles a FULL multi-dimensional training step — DModule plans, compiled
 ppermute pipeline, ZeRO-sharded optimizer, vocab-parallel loss — against a
 virtual 32-device topology at seq 4096, entirely ahead-of-time: parameters
 exist only as ShapeDtypeStructs, so the model never materializes.  Rungs
-(VESCALE_AOT_MODEL): ``8b`` Llama-3-8B pp2 x dp4 x tp4 (default), ``70b``
-Llama-3-70B pp4 x dp2 x tp4, ``mixtral`` Mixtral-8x7B pp2 x dp2 x ep4 x tp2
-(expert-parallel all-to-all included in the roofline).  From the
+(VESCALE_AOT_MODEL): ``8b`` Llama-3-8B pp2 x dp4 x tp4 on 32 virtual devices
+(default), ``70b`` Llama-3-70B pp4 x dp2 x tp4 on 32, ``405b`` Llama-3-405B
+pp8 x dp2 x tp4 on 64 (v5p-256 structural check), ``mixtral`` Mixtral-8x7B
+pp2 x dp2 x ep4 x tp2 on 32 (expert-parallel all-to-all in the roofline).  From the
 partitioned, optimized HLO it reports:
 
   MEASURED (from the compiled executable):
@@ -40,13 +41,13 @@ import subprocess
 import sys
 import time
 
-# Model rung: VESCALE_AOT_MODEL=8b (default) | 70b | mixtral.  All compile
-# against a 32-virtual-device topology; 70b uses a deeper pp split, mixtral
-# adds an ep mesh dim (the BASELINE.md ladder's 70B 4D and Mixtral EP rungs).
+# Model rung: VESCALE_AOT_MODEL=8b (default) | 70b | 405b | mixtral.
+# 8b/70b/mixtral compile on 32 virtual devices; 405b on 64.  70b/405b deepen
+# the pp split, mixtral adds an ep mesh dim (BASELINE.md ladder rungs).
 MODEL = os.environ.get("VESCALE_AOT_MODEL", "8b")
-if MODEL not in ("8b", "70b", "mixtral"):
+if MODEL not in ("8b", "70b", "405b", "mixtral"):
     raise SystemExit(
-        f"VESCALE_AOT_MODEL={MODEL!r}: expected one of 8b | 70b | mixtral "
+        f"VESCALE_AOT_MODEL={MODEL!r}: expected one of 8b | 70b | 405b | mixtral "
         "(an unknown value would compile the 8b config but label the report "
         "with the wrong rung)"
     )
@@ -54,6 +55,12 @@ N_DEVICES = 32
 EP = 1
 if MODEL == "70b":
     PP, DP, TP = 4, 2, 4
+    PER_DP_BATCH = 2
+elif MODEL == "405b":
+    # the ladder's deepest rung (BASELINE.md: 405B 5D on v5p-256): the
+    # virtual compile uses 64 devices; dp scales out on real hardware
+    N_DEVICES = 64
+    PP, DP, TP = 8, 2, 4
     PER_DP_BATCH = 2
 elif MODEL == "mixtral":
     PP, DP, EP, TP = 2, 2, 4, 2  # 5D-style: pp x dp x ep x tp
@@ -126,9 +133,24 @@ def main():
     # collective structure is dtype-independent and the roofline uses bf16
     # byte counts, but MEASURED per-device memory below is the fp32 figure
     # (bf16 params/grads/activations halve their share of it).
+    # shared llama fields + the four per-rung dims (405b: 126 layers rounded
+    # to a pp8-divisible 128)
+    COMMON = dict(
+        vocab_size=128256, num_key_value_heads=8, max_position_embeddings=SEQ,
+        rope_theta=500000.0, use_flash_attention=False, remat=True,
+        dtype=jnp.float32,
+    )
+    RUNG = {
+        "8b": dict(hidden_size=4096, intermediate_size=14336,
+                   num_hidden_layers=32, num_attention_heads=32),
+        "70b": dict(hidden_size=8192, intermediate_size=28672,
+                    num_hidden_layers=80, num_attention_heads=64),
+        "405b": dict(hidden_size=16384, intermediate_size=53248,
+                     num_hidden_layers=128, num_attention_heads=128),
+    }
     moe_cfg = None
     if MODEL == "mixtral":
-        from vescale_tpu.models.mixtral import MixtralBlock, MixtralConfig
+        from vescale_tpu.models.mixtral import MixtralConfig
 
         moe_cfg = MixtralConfig(
             vocab_size=32000,
@@ -143,38 +165,11 @@ def main():
             max_position_embeddings=SEQ,
             dtype=jnp.float32,
         )
-        cfg = moe_cfg.as_llama()
         cfg = __import__("dataclasses").replace(
-            cfg, use_flash_attention=False, dtype=jnp.float32
-        )
-    elif MODEL == "70b":
-        cfg = LlamaConfig(
-            vocab_size=128256,
-            hidden_size=8192,
-            intermediate_size=28672,
-            num_hidden_layers=80,
-            num_attention_heads=64,
-            num_key_value_heads=8,
-            max_position_embeddings=SEQ,
-            rope_theta=500000.0,
-            use_flash_attention=False,
-            remat=True,
-            dtype=jnp.float32,
+            moe_cfg.as_llama(), use_flash_attention=False, dtype=jnp.float32
         )
     else:
-        cfg = LlamaConfig(
-            vocab_size=128256,
-            hidden_size=4096,
-            intermediate_size=14336,
-            num_hidden_layers=32,
-            num_attention_heads=32,
-            num_key_value_heads=8,
-            max_position_embeddings=SEQ,
-            rope_theta=500000.0,
-            use_flash_attention=False,
-            remat=True,
-            dtype=jnp.float32,
-        )
+        cfg = LlamaConfig(**COMMON, **RUNG[MODEL])
     layers_per_stage = cfg.num_hidden_layers // PP
     B = DP * PER_DP_BATCH
     T = SEQ
@@ -382,6 +377,12 @@ def main():
                     "halve (and bf16 halves the param/grad share again)"
                 }
                 if MODEL == "mixtral"
+                else {
+                    "topology_note": "64-virtual-chip structural check of the "
+                    "v5p-256 rung: on 256 chips dp scales 2 -> 8, cutting the "
+                    "ZeRO state per device 4x (and bf16 halves params/grads)"
+                }
+                if MODEL == "405b"
                 else {}
             ),
         },

@@ -126,6 +126,7 @@ def save(
     checkpoint_state: Dict[str, Any],
     async_checkpoint: bool = False,
     num_io_workers: int = 4,
+    on_commit=None,
 ) -> Optional[CheckpointHandle]:
     """Save a state dict of pytrees (reference checkpoint/__init__.py:16).
 
@@ -134,7 +135,11 @@ def save(
     writes with cross-replica dedup); process 0 commits ``meta.json`` after
     a barrier, so a reader never sees a torn checkpoint.  NOTE: with
     ``async_checkpoint=True`` under multi-process, the returned handle MUST
-    be ``wait()``ed — the commit barrier runs on the calling thread."""
+    be ``wait()``ed — the commit barrier runs on the calling thread.
+
+    ``on_commit``: called (on whatever thread runs the commit) right after
+    meta.json lands — fire-and-forget async callers get an exact
+    commit-time hook (CheckpointManager rotation) without polling."""
     storage = _storage_for(path)
     writer = AsyncWriter(storage, num_io_workers)
     meta: Dict[str, Any] = {"arrays": {}}
@@ -179,6 +184,8 @@ def save(
             barrier(f"ckpt_save:{path}")
         if me == 0:
             storage.write_bytes("meta.json", json.dumps(meta).encode())
+        if on_commit is not None:
+            on_commit()
 
     if nproc == 1:
         # single-process: no barrier needed, so the commit can chase the
@@ -189,9 +196,12 @@ def save(
         def _finalize():
             for f in data_futures:
                 f.result()
+            writer.drain_native()  # meta.json may only chase durable chunks
             _commit()
             # fire-and-forget callers never wait(): release the io threads
-            # (wait=False — a worker cannot join its own pool)
+            # (wait=False — a worker cannot join its own pool) and the
+            # native pool
+            writer.close_native()
             writer.pool.shutdown(wait=False)
 
         writer.futures = writer.futures + [writer.pool.submit(_finalize)]

@@ -42,6 +42,12 @@ class CheckpointManager:
         self.root = root
         self.keep = int(keep)
         os.makedirs(root, exist_ok=True)
+        # highest step save() was ever asked for, seeded from disk so a
+        # RESTARTED process that resumes from an older step still recognizes
+        # the on-disk newer steps as stale futures when it next saves
+        committed = self._committed_steps()
+        self._max_requested = committed[-1] if committed else -1
+        self._pending: Dict[int, CheckpointHandle] = {}  # in-flight async saves
 
     # ------------------------------------------------------------- paths
     def step_path(self, step: int) -> str:
@@ -74,59 +80,58 @@ class CheckpointManager:
     ) -> Optional[CheckpointHandle]:
         """Save under ``root/step_<N>/`` and prune old committed steps down
         to ``keep`` (rotation runs on process 0 after the save commits)."""
-        handle = save(self.step_path(step), checkpoint_state, async_checkpoint=async_checkpoint)
+        # Rollback intent is decided NOW, at request time: saving a step
+        # below one already requested means the run resumed from an older
+        # step and everything newer is divergent history.  (Deciding at
+        # rotate time instead races concurrent ASCENDING async saves: an
+        # earlier step's late-firing rotation would see a later step as a
+        # "stale future" and delete the newest checkpoint.)
+        rollback = step < self._max_requested
+        self._max_requested = max(self._max_requested, step)
+        # prune finished saves: wait()ed handles, and fire-and-forget ones
+        # whose commit marker already landed
+        self._pending = {
+            s: h
+            for s, h in self._pending.items()
+            if not h._done and not os.path.exists(os.path.join(self.step_path(s), "meta.json"))
+        }
+        if rollback:
+            # an IN-FLIGHT async save of a now-stale future step would race
+            # the pruning below: its late writers recreate the pruned dir
+            # and commit it as the (possibly torn) latest checkpoint.  Wait
+            # those saves out first; their committed dirs are then pruned
+            # deterministically.
+            for s in sorted(self._pending):
+                if s > step:
+                    self._pending.pop(s).wait()
 
         def _rotate():
             if jax.process_index() != 0:
                 return
-            # saving step N makes any committed step > N a STALE FUTURE
-            # (the run was resumed from an older step and diverged): prune
-            # those first, or the oldest-first cut below could delete the
-            # checkpoint just saved while keeping the stale ones — and the
-            # next crash-resume would restore the pre-rollback state
-            steps = [s for s in self._committed_steps() if s != step]
-            for s in steps:
-                if s > step:
-                    shutil.rmtree(self.step_path(s), ignore_errors=True)
-            steps = [s for s in steps if s < step] + [step]
+            steps = self._committed_steps()
+            if rollback:
+                # prune the stale futures first, or the oldest-first cut
+                # below could delete the checkpoint just saved while keeping
+                # them — the next crash-resume would restore pre-rollback
+                # state
+                for s in steps:
+                    if s > step:
+                        shutil.rmtree(self.step_path(s), ignore_errors=True)
+                steps = [s for s in steps if s <= step]
             for s in steps[: max(0, len(steps) - self.keep)]:
                 shutil.rmtree(self.step_path(s), ignore_errors=True)
 
-        if handle is None:
-            _rotate()
-            return None
-        # async: rotate at commit time, chained on the caller's wait()
-        orig_commit = handle._commit
-
-        def commit_then_rotate():
-            if orig_commit is not None:
-                orig_commit()
-            _rotate()
-
-        # single-process async saves commit meta.json on the io pool (which
-        # wait() drains first), so rotating inside the wait()-time commit
-        # hook is correct in both modes
-        handle._commit = commit_then_rotate
-        if jax.process_count() == 1:
-            # the documented recovery loop fire-and-forgets async saves
-            # (single-process saves are durable without wait()): rotation
-            # must still happen — a watcher thread rotates once the commit
-            # marker lands.  (Racing a caller that DOES wait() is fine:
-            # rotation is idempotent rmtree(ignore_errors).)
-            import threading
-            import time as _time
-
-            marker = os.path.join(self.step_path(step), "meta.json")
-
-            def _watch():
-                deadline = _time.time() + 3600.0
-                while _time.time() < deadline:
-                    if os.path.exists(marker):
-                        _rotate()
-                        return
-                    _time.sleep(0.2)
-
-            threading.Thread(target=_watch, daemon=True).start()
+        # on_commit runs exactly when meta.json lands — on this thread for
+        # sync saves, on the io pool for fire-and-forget async saves, and
+        # inside wait() for multi-process async saves
+        handle = save(
+            self.step_path(step),
+            checkpoint_state,
+            async_checkpoint=async_checkpoint,
+            on_commit=_rotate,
+        )
+        if handle is not None:
+            self._pending[step] = handle
         return handle
 
     # ----------------------------------------------------------- restore

@@ -1,0 +1,98 @@
+"""ctypes binding for the native checkpoint chunk writer (ckpt_io.cpp).
+
+The C++ pool does open/write/fsync/rename outside the GIL (the io-worker
+role of the reference's storage/filesystem.py, whose heavy lifting sat in
+torch's C++).  Falls back cleanly when no toolchain is available —
+``NativeWritePool.get()`` returns None and callers keep the Python pool.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+__all__ = ["NativeWritePool", "build_native"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SRC = os.path.join(_NATIVE_DIR, "ckpt_io.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libvck.so")
+_BUILD_LOCK = threading.Lock()
+_LIB = None
+_LIB_FAILED = False
+
+
+def build_native(force: bool = False) -> str:
+    """Compile the writer (g++ -O3 -shared) if needed; returns the .so path."""
+    with _BUILD_LOCK:
+        if force or not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", _SRC, "-o", _SO]
+            subprocess.run(cmd, check=True, capture_output=True)
+    return _SO
+
+
+def _lib():
+    global _LIB, _LIB_FAILED
+    if _LIB is None and not _LIB_FAILED:
+        try:
+            lib = ctypes.CDLL(build_native())
+            lib.vck_create.restype = ctypes.c_void_p
+            lib.vck_create.argtypes = [ctypes.c_int]
+            lib.vck_submit.restype = ctypes.c_int
+            lib.vck_submit.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+            ]
+            lib.vck_drain.restype = ctypes.c_int
+            lib.vck_drain.argtypes = [ctypes.c_void_p]
+            lib.vck_destroy.restype = None
+            lib.vck_destroy.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+        except (OSError, subprocess.CalledProcessError):
+            _LIB_FAILED = True
+    return _LIB
+
+
+class NativeWritePool:
+    """Native writer pool (threads live in C++), one PER AsyncWriter: a
+    shared singleton would pool the failure counter across concurrent
+    saves, letting save A's failed chunk surface on save B's drain while A
+    commits a torn checkpoint.  Per-writer pools keep failure attribution
+    exact and honor each save's ``num_io_workers``."""
+
+    def __init__(self, lib, num_threads: int):
+        self._lib = lib
+        self._pool = lib.vck_create(num_threads)
+        self._closed = False
+
+    @classmethod
+    def get(cls, num_threads: int = 4) -> Optional["NativeWritePool"]:
+        lib = _lib()
+        if lib is None:
+            return None
+        return cls(lib, num_threads)
+
+    def submit(self, path: str, data: bytes) -> None:
+        rc = self._lib.vck_submit(self._pool, path.encode(), data, len(data))
+        if rc != 0:
+            raise IOError(f"native checkpoint writer rejected {path}")
+
+    def drain(self) -> None:
+        failures = self._lib.vck_drain(self._pool)
+        if failures:
+            raise IOError(f"native checkpoint writer: {failures} chunk write(s) failed")
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._lib.vck_destroy(self._pool)
+
+    def __del__(self):  # backstop; close() is the real path
+        try:
+            self.close()
+        except Exception:
+            pass

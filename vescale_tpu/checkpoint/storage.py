@@ -112,7 +112,12 @@ def bytes_to_array(data: bytes) -> np.ndarray:
 
 class AsyncWriter:
     """Thread-pool chunk writer (reference async io workers,
-    filesystem.py).  ``submit`` enqueues a write; ``wait`` drains."""
+    filesystem.py).  ``submit`` enqueues a write; ``wait`` drains.
+
+    Filesystem chunk writes route through the NATIVE C++ pool when
+    available (checkpoint/native_io.py: open/write/fsync/rename outside the
+    GIL — the reference's io workers ride torch's C++; ours are our own).
+    ``VESCALE_NATIVE_CKPT_IO=0`` forces the Python pool."""
 
     def __init__(self, storage: Storage, num_workers: int = 4):
         self.storage = storage
@@ -120,9 +125,21 @@ class AsyncWriter:
         # waiting on data writes, which need another to make progress
         self.pool = _fut.ThreadPoolExecutor(max_workers=max(2, num_workers))
         self.futures: List[_fut.Future] = []
+        self._native = None
+        if isinstance(storage, FileSystemStorage) and os.environ.get(
+            "VESCALE_NATIVE_CKPT_IO", "1"
+        ) != "0":
+            from .native_io import NativeWritePool
+
+            self._native = NativeWritePool.get(num_workers)
 
     def submit(self, name: str, arr: np.ndarray) -> None:
         data = array_to_bytes(arr)  # D2H + serialize on the caller thread
+        if self._native is not None:
+            # plain join — the C++ writer creates parent dirs itself; a
+            # makedirs walk here would put syscalls back on this thread
+            self._native.submit(os.path.join(self.storage.root, name), data)
+            return
         self.futures.append(self.pool.submit(self.storage.write_bytes, name, data))
 
     def write_json(self, name: str, obj) -> None:
@@ -130,11 +147,24 @@ class AsyncWriter:
             self.pool.submit(self.storage.write_bytes, name, json.dumps(obj).encode())
         )
 
+    def drain_native(self) -> None:
+        """Block until every native chunk write is durable (no-op without
+        the native pool).  Must run before any commit marker is written."""
+        if self._native is not None:
+            self._native.drain()
+
+    def close_native(self) -> None:
+        if self._native is not None:
+            self._native.close()
+            self._native = None
+
     def wait(self) -> None:
         for f in self.futures:
             f.result()
         self.futures.clear()
+        self.drain_native()
 
     def shutdown(self) -> None:
         self.wait()
         self.pool.shutdown()
+        self.close_native()
