@@ -409,6 +409,36 @@ def test_checkpoint_manager_rollback_prunes_stale_futures(tmp_path, mesh1d):
     )
 
 
+def test_checkpoint_manager_reascend_after_rollback(tmp_path, mesh1d):
+    """regression: after a rollback save, later ASCENDING saves are normal
+    saves — the rollback's deletion set is fixed at request time and the
+    watermark resets, so a slow rollback commit can never delete the
+    re-ascending checkpoints that follow it."""
+    import os
+    import time
+
+    from vescale_tpu.checkpoint.manager import CheckpointManager
+
+    root = str(tmp_path / "ra")
+    x = np.arange(8, dtype=np.float32)
+    m0 = CheckpointManager(root, keep=3)
+    m0.save(200, {"m": {"x": vt.distribute_tensor(x, mesh1d, [Shard(0)])}})
+    # fresh process resumes from an older step and re-ascends
+    mgr = CheckpointManager(root, keep=3)
+    h1 = mgr.save(100, {"m": {"x": vt.distribute_tensor(x + 1, mesh1d, [Shard(0)])}},
+                  async_checkpoint=True)
+    h2 = mgr.save(101, {"m": {"x": vt.distribute_tensor(x + 2, mesh1d, [Shard(0)])}},
+                  async_checkpoint=True)
+    h1.wait()
+    h2.wait()
+    deadline = time.time() + 20
+    while time.time() < deadline and mgr.latest_step() != 101:
+        time.sleep(0.2)
+    assert mgr.latest_step() == 101
+    assert os.path.exists(mgr.step_path(100))
+    assert not os.path.exists(mgr.step_path(200))
+
+
 def test_native_ckpt_writer(tmp_path, mesh1d, monkeypatch):
     """The C++ chunk writer (checkpoint/native/ckpt_io.cpp) builds, writes
     atomically (tmp+fsync+rename), and the python pool takes over when

@@ -8,9 +8,10 @@ exists — a torn save from a crashed run is invisible, __init__.py commit
 protocol).  The MegaScale-style recovery loop (checkpoint/README.md:49):
 
     mgr = CheckpointManager("gs-or-fs/ckpts", keep=3)
-    step = mgr.latest_step()
-    state = mgr.restore({"model": tmpl, "optimizer": opt_tmpl}) if step else init()
-    for i in count(step or 0):
+    step = mgr.latest_step()            # None when nothing is restorable
+    state = (mgr.restore({"model": tmpl, "optimizer": opt_tmpl})
+             if step is not None else init())
+    for i in count(step + 1 if step is not None else 0):
         ...train...
         if i % 1000 == 0:
             mgr.save(i, {"model": params, "optimizer": opt}, async_checkpoint=True)
@@ -87,7 +88,6 @@ class CheckpointManager:
         # earlier step's late-firing rotation would see a later step as a
         # "stale future" and delete the newest checkpoint.)
         rollback = step < self._max_requested
-        self._max_requested = max(self._max_requested, step)
         # prune finished saves: wait()ed handles, and fire-and-forget ones
         # whose commit marker already landed
         self._pending = {
@@ -95,6 +95,7 @@ class CheckpointManager:
             for s, h in self._pending.items()
             if not h._done and not os.path.exists(os.path.join(self.step_path(s), "meta.json"))
         }
+        stale_futures: List[int] = []
         if rollback:
             # an IN-FLIGHT async save of a now-stale future step would race
             # the pruning below: its late writers recreate the pruned dir
@@ -104,20 +105,25 @@ class CheckpointManager:
             for s in sorted(self._pending):
                 if s > step:
                     self._pending.pop(s).wait()
+            # the CONCRETE deletion set is fixed NOW: a slow rollback save
+            # whose commit fires after later (re-ascending) saves must not
+            # re-evaluate "committed > step" then and destroy them
+            stale_futures = [s for s in self._committed_steps() if s > step]
+            # the timeline restarts at this step: later ascending saves are
+            # normal saves, not rollbacks against the old watermark
+            self._max_requested = step
+        self._max_requested = max(self._max_requested, step)
 
         def _rotate():
             if jax.process_index() != 0:
                 return
-            steps = self._committed_steps()
-            if rollback:
+            for s in stale_futures:
                 # prune the stale futures first, or the oldest-first cut
                 # below could delete the checkpoint just saved while keeping
                 # them — the next crash-resume would restore pre-rollback
                 # state
-                for s in steps:
-                    if s > step:
-                        shutil.rmtree(self.step_path(s), ignore_errors=True)
-                steps = [s for s in steps if s <= step]
+                shutil.rmtree(self.step_path(s), ignore_errors=True)
+            steps = [s for s in self._committed_steps() if s not in stale_futures]
             for s in steps[: max(0, len(steps) - self.keep)]:
                 shutil.rmtree(self.step_path(s), ignore_errors=True)
 

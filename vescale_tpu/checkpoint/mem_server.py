@@ -165,21 +165,25 @@ class MemServer:
                 os.remove(path)
 
 
-class RemoteMemoryStorage(Storage):
-    """checkpoint.Storage client talking to a (possibly detached) MemServer.
+class _ServerConn:
+    """One persistent, lock-serialized socket per SERVER NAME, shared by
+    every RemoteMemoryStorage prefix view — per-prefix sockets would leak
+    one fd per checkpoint name (a step-per-save workload exhausts ulimit)."""
 
-    One persistent connection, lock-serialized (the io-worker pool calls
-    concurrently); ``prefix`` namespaces several checkpoints in one server
-    (the reference's per-name directories)."""
+    _registry: Dict[str, "_ServerConn"] = {}
+    _rlock = threading.Lock()
 
-    def __init__(self, name: str, prefix: str = ""):
+    def __init__(self, name: str):
         self.name = name
-        self.prefix = prefix.strip("/")
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
-    def _full(self, key: str) -> str:
-        return f"{self.prefix}/{key}" if self.prefix else key
+    @classmethod
+    def get(cls, name: str) -> "_ServerConn":
+        with cls._rlock:
+            if name not in cls._registry:
+                cls._registry[name] = cls(name)
+            return cls._registry[name]
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
@@ -188,7 +192,7 @@ class RemoteMemoryStorage(Storage):
             self._sock = s
         return self._sock
 
-    def _call(self, op: bytes, name: str, payload: bytes = b"") -> Tuple[int, bytes]:
+    def call(self, op: bytes, name: str, payload: bytes = b"") -> Tuple[int, bytes]:
         with self._lock:
             try:
                 sock = self._conn()
@@ -202,6 +206,31 @@ class RemoteMemoryStorage(Storage):
                 sock = self._conn()
                 _send_msg(sock, op, name, payload)
                 return _recv_reply(sock)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+
+
+class RemoteMemoryStorage(Storage):
+    """checkpoint.Storage client talking to a (possibly detached) MemServer.
+
+    ``prefix`` namespaces several checkpoints in one server (the
+    reference's per-name directories); all prefixes of one server share
+    one socket (see _ServerConn)."""
+
+    def __init__(self, name: str, prefix: str = ""):
+        self.name = name
+        self.prefix = prefix.strip("/")
+        self._connection = _ServerConn.get(name)
+
+    def _full(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def _call(self, op: bytes, name: str, payload: bytes = b"") -> Tuple[int, bytes]:
+        return self._connection.call(op, name, payload)
 
     # ------------------------------------------------------- Storage api
     def write_bytes(self, name: str, data: bytes) -> None:
@@ -237,10 +266,7 @@ class RemoteMemoryStorage(Storage):
             return False
 
     def close(self) -> None:
-        with self._lock:
-            if self._sock is not None:
-                self._sock.close()
-                self._sock = None
+        self._connection.close()
 
 
 # ------------------------------------------------------------ entry points
