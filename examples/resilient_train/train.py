@@ -88,8 +88,11 @@ def main():
             return dopt.scale_loss(cross_entropy_loss(logits, batch["target"]), opt_state)
 
         loss, grads = jax.value_and_grad(lf)(params)
+        # unscale with the PRE-step scale (the one lf multiplied by) — the
+        # post-step scale differs on backoff/growth steps
+        unscaled = loss / dopt.current_scale(opt_state)
         params, opt_state = dopt.step(params, opt_state, grads)
-        return params, opt_state, loss / dopt.current_scale(opt_state)
+        return params, opt_state, unscaled
 
     rng = np.random.default_rng(0)
     handle = None

@@ -145,5 +145,20 @@ assert stats["bytes_read"] <= dval.nbytes + 4 * 512, stats
 for sh in loaded_d["m"]["d"].data.addressable_shards:
     np.testing.assert_array_equal(np.asarray(sh.data), dval[sh.index])
 
+# ---- CheckpointManager across processes: save barriers + proc-0 rotation
+from vescale_tpu.checkpoint import CheckpointManager  # noqa: E402
+
+mgr_root = os.path.join(ckpt_dir, "..", "mgr")
+mgr = CheckpointManager(mgr_root, keep=2)
+for step in (1, 2, 3):
+    mgr.save(step, {"model": params})  # sync: commit barrier inside
+vdist.barrier("after_mgr_saves")
+assert mgr.latest_step() == 3, mgr.latest_step()
+assert not os.path.exists(mgr.step_path(1))  # rotated (proc 0), visible to all
+restored = mgr.restore({"model": params})
+for k in ("W", "b"):
+    d = float(maxdiff(restored["model"][k], params[k]))
+    assert d < 1e-6, ("mgr", k, d)
+
 vdist.barrier("done")
 print(f"OK proc {me}")

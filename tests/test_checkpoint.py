@@ -427,9 +427,13 @@ def test_checkpoint_manager_reascend_after_rollback(tmp_path, mesh1d):
     mgr = CheckpointManager(root, keep=3)
     h1 = mgr.save(100, {"m": {"x": vt.distribute_tensor(x + 1, mesh1d, [Shard(0)])}},
                   async_checkpoint=True)
+    # rollback saves commit SYNCHRONOUSLY (the deferred-deletion race class
+    # is removed wholesale): no handle, and the stale future is gone now
+    assert h1 is None
+    assert not os.path.exists(mgr.step_path(200))
     h2 = mgr.save(101, {"m": {"x": vt.distribute_tensor(x + 2, mesh1d, [Shard(0)])}},
                   async_checkpoint=True)
-    h1.wait()
+    assert h2 is not None  # ascending save stays async
     h2.wait()
     deadline = time.time() + 20
     while time.time() < deadline and mgr.latest_step() != 101:

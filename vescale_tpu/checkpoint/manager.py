@@ -15,6 +15,11 @@ protocol).  The MegaScale-style recovery loop (checkpoint/README.md:49):
         ...train...
         if i % 1000 == 0:
             mgr.save(i, {"model": params, "optimizer": opt}, async_checkpoint=True)
+
+Contract: ONE CheckpointManager instance owns a root per process (the
+reference checkpointer's assumption too).  Saves issued behind the
+manager's back (a second instance, direct ckpt.save into the root) cannot
+be tracked, so rollback pruning cannot wait them out.
 """
 
 from __future__ import annotations
@@ -81,12 +86,15 @@ class CheckpointManager:
     ) -> Optional[CheckpointHandle]:
         """Save under ``root/step_<N>/`` and prune old committed steps down
         to ``keep`` (rotation runs on process 0 after the save commits)."""
-        # Rollback intent is decided NOW, at request time: saving a step
-        # below one already requested means the run resumed from an older
-        # step and everything newer is divergent history.  (Deciding at
-        # rotate time instead races concurrent ASCENDING async saves: an
-        # earlier step's late-firing rotation would see a later step as a
-        # "stale future" and delete the newest checkpoint.)
+        # Rollback (saving a step below one already requested: the run
+        # resumed from an older step; everything newer is divergent
+        # history) is handled ENTIRELY synchronously, before the new save
+        # starts.  Every previous attempt to defer the stale-future pruning
+        # to commit time raced some interleaving of concurrent async saves
+        # (late-firing rotations re-evaluating "committed > step", reused
+        # step numbers, ascending keep-cuts counting doomed dirs).  The
+        # synchronous design has no deferred deletions at all: by the time
+        # any later save is requested, the stale dirs are gone.
         rollback = step < self._max_requested
         # prune finished saves: wait()ed handles, and fire-and-forget ones
         # whose commit marker already landed
@@ -95,35 +103,30 @@ class CheckpointManager:
             for s, h in self._pending.items()
             if not h._done and not os.path.exists(os.path.join(self.step_path(s), "meta.json"))
         }
-        stale_futures: List[int] = []
         if rollback:
-            # an IN-FLIGHT async save of a now-stale future step would race
-            # the pruning below: its late writers recreate the pruned dir
-            # and commit it as the (possibly torn) latest checkpoint.  Wait
-            # those saves out first; their committed dirs are then pruned
-            # deterministically.
+            # in-flight async saves could still be writing into dirs about
+            # to be pruned (their late writers would resurrect them): wait
+            # every pending save out, then prune the stale futures NOW
             for s in sorted(self._pending):
-                if s > step:
-                    self._pending.pop(s).wait()
-            # the CONCRETE deletion set is fixed NOW: a slow rollback save
-            # whose commit fires after later (re-ascending) saves must not
-            # re-evaluate "committed > step" then and destroy them
-            stale_futures = [s for s in self._committed_steps() if s > step]
-            # the timeline restarts at this step: later ascending saves are
-            # normal saves, not rollbacks against the old watermark
+                self._pending.pop(s).wait()
+            if jax.process_index() == 0:
+                for s in self._committed_steps():
+                    if s > step:
+                        shutil.rmtree(self.step_path(s), ignore_errors=True)
+            # the timeline restarts here: later ascending saves are normal
             self._max_requested = step
+            # rollbacks are rare; committing synchronously removes the
+            # whole slow-async-rollback-commit race class
+            async_checkpoint = False
         self._max_requested = max(self._max_requested, step)
 
         def _rotate():
+            # pure oldest-first keep-K cut: never touches the newest steps,
+            # so late-firing rotations of concurrent ascending saves are
+            # harmless in any interleaving
             if jax.process_index() != 0:
                 return
-            for s in stale_futures:
-                # prune the stale futures first, or the oldest-first cut
-                # below could delete the checkpoint just saved while keeping
-                # them — the next crash-resume would restore pre-rollback
-                # state
-                shutil.rmtree(self.step_path(s), ignore_errors=True)
-            steps = [s for s in self._committed_steps() if s not in stale_futures]
+            steps = self._committed_steps()
             for s in steps[: max(0, len(steps) - self.keep)]:
                 shutil.rmtree(self.step_path(s), ignore_errors=True)
 

@@ -166,17 +166,22 @@ class MemServer:
 
 
 class _ServerConn:
-    """One persistent, lock-serialized socket per SERVER NAME, shared by
-    every RemoteMemoryStorage prefix view — per-prefix sockets would leak
-    one fd per checkpoint name (a step-per-save workload exhausts ulimit)."""
+    """A BOUNDED pool of persistent sockets per SERVER NAME, shared by
+    every RemoteMemoryStorage prefix view.  Per-prefix sockets would leak
+    one fd per checkpoint name (a step-per-save workload exhausts ulimit);
+    a single lock-serialized socket would serialize every multi-MB payload
+    across concurrent saves/loads.  K sockets give parallel transfers with
+    O(1) fds."""
 
+    POOL_SIZE = 4
     _registry: Dict[str, "_ServerConn"] = {}
     _rlock = threading.Lock()
 
     def __init__(self, name: str):
         self.name = name
-        self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        self._socks: List[Optional[socket.socket]] = [None] * self.POOL_SIZE
+        self._locks = [threading.Lock() for _ in range(self.POOL_SIZE)]
+        self._rr = 0
 
     @classmethod
     def get(cls, name: str) -> "_ServerConn":
@@ -185,33 +190,46 @@ class _ServerConn:
                 cls._registry[name] = cls(name)
             return cls._registry[name]
 
-    def _conn(self) -> socket.socket:
-        if self._sock is None:
+    def _conn(self, slot: int) -> socket.socket:
+        if self._socks[slot] is None:
             s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             s.connect(sock_path(self.name))
-            self._sock = s
-        return self._sock
+            self._socks[slot] = s
+        return self._socks[slot]
 
     def call(self, op: bytes, name: str, payload: bytes = b"") -> Tuple[int, bytes]:
-        with self._lock:
+        # prefer an idle slot (parallel transfers); fall back to blocking
+        # on the round-robin slot
+        for i in range(self.POOL_SIZE):
+            slot = (self._rr + i) % self.POOL_SIZE
+            if self._locks[slot].acquire(blocking=False):
+                break
+        else:
+            slot = self._rr % self.POOL_SIZE
+            self._locks[slot].acquire()
+        self._rr = (slot + 1) % self.POOL_SIZE
+        try:
             try:
-                sock = self._conn()
+                sock = self._conn(slot)
                 _send_msg(sock, op, name, payload)
                 return _recv_reply(sock)
             except (ConnectionError, OSError):
                 # one reconnect: the server may have restarted between calls
-                if self._sock is not None:
-                    self._sock.close()
-                    self._sock = None
-                sock = self._conn()
+                if self._socks[slot] is not None:
+                    self._socks[slot].close()
+                    self._socks[slot] = None
+                sock = self._conn(slot)
                 _send_msg(sock, op, name, payload)
                 return _recv_reply(sock)
+        finally:
+            self._locks[slot].release()
 
     def close(self) -> None:
-        with self._lock:
-            if self._sock is not None:
-                self._sock.close()
-                self._sock = None
+        for slot in range(self.POOL_SIZE):
+            with self._locks[slot]:
+                if self._socks[slot] is not None:
+                    self._socks[slot].close()
+                    self._socks[slot] = None
 
 
 class RemoteMemoryStorage(Storage):
