@@ -369,6 +369,44 @@ def test_strided_ragged_transition_kernels():
         )
 
 
+def test_strided_ragged_all_to_all_v():
+    """strided-ragged -> strided-ragged' (fsdp x ep reallocation under a
+    composing tp Shard): the combined-flat-rank ppermute plan matches the
+    logical golden for unit changes in either direction."""
+    from vescale_tpu.placements import StridedRaggedShard
+    from vescale_tpu.spec import DArraySpec, TensorMeta
+    from vescale_tpu.transfer import ragged_transition_fn
+
+    mesh = vt.DeviceMesh(("tp", "fsdp"), (2, 4))
+    x = np.arange(64, dtype=np.float32)
+    sa = [Shard(0), StridedRaggedShard((0,), (1, 2, 3, 2), split_factor=2)]
+    sb = [Shard(0), StridedRaggedShard((0,), (2, 3, 2, 1), split_factor=2)]
+    # the SAME transitions with the RAGGED dim FIRST in the mesh: pins the
+    # inner>rj branch of the ppermute rank remap (mesh-order vs tuple-order
+    # flattening) — a jax semantics change would scramble data silently
+    mesh_rev = vt.DeviceMesh(("fsdp", "tp"), (4, 2))
+    ra = [StridedRaggedShard((0,), (1, 2, 3, 2), split_factor=2), Shard(0)]
+    rb = [StridedRaggedShard((0,), (2, 3, 2, 1), split_factor=2), Shard(0)]
+    meta = TensorMeta((64,), jnp.dtype(jnp.float32))
+    for m, src_pl, dst_pl in [
+        (mesh, sa, sb), (mesh, sb, sa), (mesh_rev, ra, rb), (mesh_rev, rb, ra)
+    ]:
+        src = DArraySpec(m, src_pl, meta)
+        dst = DArraySpec(m, dst_pl, meta)
+        assert ragged_transition_fn(src, dst) is not None, (src_pl, dst_pl)
+        d = vt.distribute_tensor(x, m, src_pl)
+        r = vt.redistribute(d, dst_pl)
+        np.testing.assert_array_equal(
+            np.asarray(r.full_tensor()), x, err_msg=str((m.mesh_dim_names, src_pl, dst_pl))
+        )
+        # per-rank locals follow the destination layout
+        for rank in (0, 3, 7):
+            np.testing.assert_array_equal(
+                np.asarray(r.to_local(rank)),
+                np.asarray(vt.distribute_tensor(x, m, dst_pl).to_local(rank)),
+            )
+
+
 def test_ragged_reshard_peak_memory_o_shard():
     """VERDICT r3 next #4 done-criterion: an 8-way ragged->ragged reshard
     keeps peak per-device bytes O(shard) — no logical-size materialization

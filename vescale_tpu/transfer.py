@@ -22,18 +22,23 @@ kernels — the reference's variable-size collectives
 (vescale/dtensor/placement_types.py:128 all-gather-v, :152 all-to-all-v):
 
   Ragged -> Replicate         all-gather-v (gather padded cells + static
-                              reassembly — dst is logical-size by definition)
-  Replicate -> Ragged         local slice-v (no comm; O(cell) output)
+                              reassembly — dst is logical-size by definition;
+                              plain AND strided)
+  Replicate -> Ragged         local slice-v (no comm; O(cell) output;
+                              plain AND strided)
   Ragged -> Ragged'           all-to-all-v (static exchange plan over the
                               ragged mesh dim; peak per-device bytes
                               O(max shard), never the logical size)
+  StridedRagged -> StridedRagged'  all-to-all-v over the combined
+                              (inner, rj) flat rank (fsdp x ep reallocation
+                              under a composing tp Shard)
 
 Coverage: same-mesh transitions where each tensor axis is sharded by at most
 one mesh dim on each side and each tensor axis participates in at most one
 transition, plus the ragged pairs above.  Everything else (interleaved,
-cross-mesh, nested shards, axis collisions, strided-ragged pairs) falls back
-to the pack/unpack path compiled under jit — correct, but may materialize
-the logical value.
+cross-mesh, nested shards, axis collisions, plain<->strided ragged pairs)
+falls back to the pack/unpack path compiled under jit — correct, but may
+materialize the logical value.
 """
 
 from __future__ import annotations
@@ -45,7 +50,7 @@ import jax
 import jax.numpy as jnp
 
 from .collectives import shard_map
-from .placements import Partial, RaggedShard, Replicate, Shard, StridedRaggedShard
+from .placements import Partial, Replicate, Shard
 from .spec import DArraySpec
 
 __all__ = ["transition_fn", "fallback_fn", "ragged_transition_fn"]
@@ -237,20 +242,6 @@ def transition_fn(src: DArraySpec, dst: DArraySpec):
 
 
 # ------------------------------------------------------- ragged kernels
-def _plain_ragged(spec: DArraySpec) -> Optional[int]:
-    """Mesh dim of a plain (non-strided) RaggedShard composed only with
-    Replicate; None otherwise."""
-    rj = None
-    for i, p in enumerate(spec.placements):
-        if isinstance(p, StridedRaggedShard):
-            return None
-        if isinstance(p, RaggedShard):
-            rj = i
-        elif not p.is_replicate():
-            return None
-    return rj
-
-
 def _any_ragged(spec: DArraySpec) -> Optional[Tuple[int, Optional[int]]]:
     """(ragged mesh dim, inner-shard mesh dim or None) for plain OR strided
     ragged specs whose remaining dims are Replicate; None otherwise."""
@@ -290,7 +281,6 @@ def ragged_transition_fn(src: DArraySpec, dst: DArraySpec):
     if src.mesh != dst.mesh or src.shape != dst.shape:
         return None
     mesh = src.mesh
-    src_rj, dst_rj = _plain_ragged(src), _plain_ragged(dst)
 
     # ---- ragged (plain OR strided) -> replicate (all-gather-v)
     src_any = _any_ragged(src)
@@ -360,58 +350,84 @@ def ragged_transition_fn(src: DArraySpec, dst: DArraySpec):
         )
         return jax.jit(fn)
 
-    # ---- ragged -> ragged' (all-to-all-v over the shared ragged mesh dim)
-    if src_rj is not None and dst_rj is not None and src_rj == dst_rj:
+    # ---- ragged -> ragged' (all-to-all-v), plain OR strided with the
+    # same inner dim: the fsdp/MoE reallocation transitions.  Device
+    # (a, r) — a = inner coord (0 when plain) — owns the global flat
+    # interval [offs[r] + a*cell_r, +cell_r); the exchange plan is computed
+    # over the combined flat rank rho = a*nj + r and executed as one
+    # ppermute round per active ring offset (delta), each sized to the
+    # LARGEST exchange at that delta.  Similar splits exchange only with
+    # ring neighbours (deltas {0, +-1}, lengths O(cell)); a rank holding
+    # most of the buffer talks to everyone but already owns O(total)
+    # itself — peak per-device bytes stay O(max shard), unlike an
+    # (n, Emax) all_to_all plan which is O(n * max overlap).
+    src_any2, dst_any2 = _any_ragged(src), _any_ragged(dst)
+    if src_any2 is not None and dst_any2 is not None and src_any2 == dst_any2:
+        rj, inner = src_any2
+        nj = mesh.shape[rj]
+        s = mesh.shape[inner] if inner is not None else 1
+        n = s * nj
         slay, dlay = src.layout(), dst.layout()
-        s_sizes, s_offs, total = _ragged_sizes_offsets(src, src_rj)
-        d_sizes, d_offs, _ = _ragged_sizes_offsets(dst, dst_rj)
-        nj = mesh.shape[src_rj]
-        rj_name = mesh.dim_name(src_rj)
-        # static exchange plan: overlap of src interval r with dst interval q
-        E = np.zeros((nj, nj), np.int32)          # exchanged lengths
-        send_start = np.zeros((nj, nj), np.int32)  # src-local offset
-        recv_start = np.zeros((nj, nj), np.int32)  # dst-local offset
-        for r in range(nj):
-            for q in range(nj):
-                g0 = max(s_offs[r], d_offs[q])
-                g1 = min(s_offs[r] + s_sizes[r], d_offs[q] + d_sizes[q])
+        s_sizes, s_offs, total = _ragged_sizes_offsets(src, rj)
+        d_sizes, d_offs, _ = _ragged_sizes_offsets(dst, rj)
+
+        def interval(offs, sizes, rho):
+            a, r = divmod(rho, nj)
+            cell = sizes[r] // s
+            return offs[r] + a * cell, cell
+
+        E = np.zeros((n, n), np.int32)          # exchanged lengths
+        send_start = np.zeros((n, n), np.int32)  # src-local offset
+        recv_start = np.zeros((n, n), np.int32)  # dst-local offset
+        for p in range(n):
+            slo, scell = interval(s_offs, s_sizes, p)
+            for q in range(n):
+                dlo, dcell = interval(d_offs, d_sizes, q)
+                g0, g1 = max(slo, dlo), min(slo + scell, dlo + dcell)
                 if g1 > g0:
-                    E[r, q] = g1 - g0
-                    send_start[r, q] = g0 - s_offs[r]
-                    recv_start[r, q] = g0 - d_offs[q]
-        # One ppermute round per active ring offset (delta), each sized to
-        # the LARGEST exchange at that delta.  Similar splits exchange only
-        # with ring neighbours (deltas {0, +-1}, lengths O(cell)); a rank
-        # holding most of the buffer talks to everyone but already owns
-        # O(total) itself — peak per-device bytes stay O(max shard), unlike
-        # an (n, Emax) all_to_all plan which is O(n * max overlap).
-        deltas = sorted({(q - r) % nj for r in range(nj) for q in range(nj) if E[r, q] > 0})
+                    E[p, q] = g1 - g0
+                    send_start[p, q] = g0 - slo
+                    recv_start[p, q] = g0 - dlo
+        deltas = sorted({(q - p) % n for p in range(n) for q in range(n) if E[p, q] > 0})
         plans = []
         for d in deltas:
-            send_q = [(r + d) % nj for r in range(nj)]
-            ln = np.asarray([E[r, send_q[r]] for r in range(nj)], np.int32)
-            sst = np.asarray([send_start[r, send_q[r]] for r in range(nj)], np.int32)
-            recv_p = [(r - d) % nj for r in range(nj)]
-            rln = np.asarray([E[recv_p[r], r] for r in range(nj)], np.int32)
-            rst = np.asarray([recv_start[recv_p[r], r] for r in range(nj)], np.int32)
+            ln = np.asarray([E[p, (p + d) % n] for p in range(n)], np.int32)
+            sst = np.asarray([send_start[p, (p + d) % n] for p in range(n)], np.int32)
+            rln = np.asarray([E[(p - d) % n, p] for p in range(n)], np.int32)
+            rst = np.asarray([recv_start[(p - d) % n, p] for p in range(n)], np.int32)
             plans.append((d, int(ln.max()), ln, sst, rln, rst))
         dst_pad = dlay.cell_pad
+        rj_name = mesh.dim_name(rj)
+        names = (mesh.dim_name(inner), rj_name) if inner is not None else rj_name
+
+        # ppermute's perm indices flatten multi-axis tuples in MESH order
+        # (jax.lax.axis_index flattens in TUPLE order — they differ when the
+        # ragged dim precedes the inner dim in the mesh; verified
+        # empirically).  Map our inner-major logical rank rho = a*nj + r
+        # into ppermute's index space before building the pairs.
+        def g(rho: int) -> int:
+            if inner is None:
+                return rho
+            a, r = divmod(rho, nj)
+            return a * nj + r if inner < rj else r * s + a
+
+        perms = {d: [(g(p), g((p + d) % n)) for p in range(n)] for d, *_ in plans}
 
         def worker(x):
             r = jax.lax.axis_index(rj_name)
+            a = jax.lax.axis_index(names[0]) if inner is not None else 0
+            rho = a * nj + r
             lmax_all = max((p[1] for p in plans), default=1)
             xp = jnp.concatenate([x, jnp.zeros((lmax_all,), x.dtype)])
             out = jnp.zeros((dst_pad,), x.dtype)
             for d, lmax, ln, sst, rln, rst in plans:
-                piece = jax.lax.dynamic_slice(xp, (jnp.asarray(sst)[r],), (lmax,))
-                piece = jnp.where(jnp.arange(lmax) < jnp.asarray(ln)[r], piece, 0)
+                piece = jax.lax.dynamic_slice(xp, (jnp.asarray(sst)[rho],), (lmax,))
+                piece = jnp.where(jnp.arange(lmax) < jnp.asarray(ln)[rho], piece, 0)
                 if d != 0:
-                    piece = jax.lax.ppermute(
-                        piece, rj_name, perm=[(i, (i + d) % nj) for i in range(nj)]
-                    )
+                    piece = jax.lax.ppermute(piece, names, perm=perms[d])
                 pos = jnp.where(
-                    jnp.arange(lmax) < jnp.asarray(rln)[r],
-                    jnp.asarray(rst)[r] + jnp.arange(lmax),
+                    jnp.arange(lmax) < jnp.asarray(rln)[rho],
+                    jnp.asarray(rst)[rho] + jnp.arange(lmax),
                     dst_pad,  # out of bounds -> dropped
                 )
                 out = out.at[pos].set(piece, mode="drop")
