@@ -32,12 +32,15 @@ kernels — the reference's variable-size collectives
   StridedRagged -> StridedRagged'  all-to-all-v over the combined
                               (inner, rj) flat rank (fsdp x ep reallocation
                               under a composing tp Shard)
+  plain <-> strided ragged    same plan; the plain side replicates its
+                              cell over the inner dim (per-expert
+                              TP-degree changes)
 
 Coverage: same-mesh transitions where each tensor axis is sharded by at most
 one mesh dim on each side and each tensor axis participates in at most one
 transition, plus the ragged pairs above.  Everything else (interleaved,
-cross-mesh, nested shards, axis collisions, plain<->strided ragged pairs)
-falls back to the pack/unpack path compiled under jit — correct, but may
+cross-mesh, nested shards, axis collisions, differing inner dims) falls
+back to the pack/unpack path compiled under jit — correct, but may
 materialize the logical value.
 """
 
@@ -362,27 +365,47 @@ def ragged_transition_fn(src: DArraySpec, dst: DArraySpec):
     # itself — peak per-device bytes stay O(max shard), unlike an
     # (n, Emax) all_to_all plan which is O(n * max overlap).
     src_any2, dst_any2 = _any_ragged(src), _any_ragged(dst)
-    if src_any2 is not None and dst_any2 is not None and src_any2 == dst_any2:
-        rj, inner = src_any2
+    if (
+        src_any2 is not None
+        and dst_any2 is not None
+        and src_any2[0] == dst_any2[0]
+        # inner dims must agree when BOTH sides are strided; a plain side
+        # simply replicates over the other side's inner dim
+        and (src_any2[1] is None or dst_any2[1] is None or src_any2[1] == dst_any2[1])
+    ):
+        rj = src_any2[0]
+        inner = src_any2[1] if src_any2[1] is not None else dst_any2[1]
         nj = mesh.shape[rj]
         s = mesh.shape[inner] if inner is not None else 1
         n = s * nj
         slay, dlay = src.layout(), dst.layout()
         s_sizes, s_offs, total = _ragged_sizes_offsets(src, rj)
         d_sizes, d_offs, _ = _ragged_sizes_offsets(dst, rj)
+        src_strided = src_any2[1] is not None
+        dst_strided = dst_any2[1] is not None
 
-        def interval(offs, sizes, rho):
+        def interval(offs, sizes, rho, strided):
+            """Data interval at combined rank rho = a*nj + r.  A strided
+            side owns its a-th slice of cell r; a plain side holds (src) or
+            needs (dst) the FULL cell at every inner coord a."""
             a, r = divmod(rho, nj)
-            cell = sizes[r] // s
-            return offs[r] + a * cell, cell
+            if strided:
+                cell = sizes[r] // s
+                return offs[r] + a * cell, cell
+            return offs[r], sizes[r]
 
         E = np.zeros((n, n), np.int32)          # exchanged lengths
         send_start = np.zeros((n, n), np.int32)  # src-local offset
         recv_start = np.zeros((n, n), np.int32)  # dst-local offset
         for p in range(n):
-            slo, scell = interval(s_offs, s_sizes, p)
+            slo, scell = interval(s_offs, s_sizes, p, src_strided)
             for q in range(n):
-                dlo, dcell = interval(d_offs, d_sizes, q)
+                if not src_strided and (p // nj) != (q // nj):
+                    # plain source: every inner row replicates the cell —
+                    # only the SAME-row copy sends, or each piece would
+                    # arrive s times
+                    continue
+                dlo, dcell = interval(d_offs, d_sizes, q, dst_strided)
                 g0, g1 = max(slo, dlo), min(slo + scell, dlo + dcell)
                 if g1 > g0:
                     E[p, q] = g1 - g0
