@@ -141,6 +141,55 @@ def test_found_inf_skip_step_and_dynamic_scale(mesh1d):
     assert "loss_scale" not in stn  # static scale carries no state
 
 
+def test_make_train_step_with_distributed_optimizer(mesh2d):
+    """make_train_step accepts a DistributedOptimizer directly: the loss is
+    scaled before grad, unscaled in the report, and the skip-step machinery
+    rides along — losses match the plain-optax path on clean steps."""
+    from vescale_tpu.train import make_train_step
+
+    model = GPT(CFG)
+    dm = parallelize_module(model, mesh2d, nanogpt_plan(mesh2d))
+    variables = dm.init(jax.random.key(0), jnp.ones((2, 8), jnp.int32))
+    params = variables["params"]
+    pspecs = jax.tree_util.tree_map(lambda p: p.sharding.spec, params)
+    dopt = DistributedOptimizer(
+        optax.adamw(1e-3), mesh2d, pspecs, loss_scale="dynamic", init_scale=64.0
+    )
+    state = dopt.init(params)
+    assert float(state["loss_scale"]["scale"]) == 64.0
+
+    step = make_train_step(dm, dopt, _loss, donate=False)
+    b = _batch(jax.random.key(7))
+    p1, s1, l1 = step(params, state, b)
+    # reported loss is UNSCALED: compare against a direct forward
+    direct = float(_loss(dm.apply({"params": params}, b["input"]), b))
+    np.testing.assert_allclose(float(l1), direct, rtol=1e-5)
+    assert float(s1["loss_scale"]["scale"]) == 64.0  # no overflow
+    assert not np.allclose(
+        np.asarray(jax.tree_util.tree_leaves(p1)[0]),
+        np.asarray(jax.tree_util.tree_leaves(params)[0]),
+    )
+    # losses track the plain-optax golden for a couple of steps
+    tx = optax.adamw(1e-3)
+    gp, go = params, tx.init(params)
+
+    @jax.jit
+    def gstep(p, o, batch):
+        def lf(pp):
+            return _loss(dm.apply({"params": pp}, batch["input"]), batch)
+
+        loss, g = jax.value_and_grad(lf)(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    dp, ds = params, state
+    for i in range(3):
+        bb = _batch(jax.random.key(50 + i))
+        dp, ds, dl = step(dp, ds, bb)
+        gp, go, gl = gstep(gp, go, bb)
+        np.testing.assert_allclose(float(dl), float(gl), rtol=5e-5, atol=5e-5)
+
+
 def test_basic_optimizer_and_clip(mesh1d):
     grads = {"a": jnp.full((4,), 3.0), "b": jnp.full((2,), 4.0)}
     clipped, norm = clip_grad_norm_fp32(grads, max_norm=1.0)

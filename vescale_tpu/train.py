@@ -23,7 +23,7 @@ __all__ = ["make_train_step", "make_eval_step"]
 
 def make_train_step(
     dmodel: DModule,
-    tx: optax.GradientTransformation,
+    tx,
     loss_fn: Callable,
     *,
     has_aux: bool = False,
@@ -33,6 +33,13 @@ def make_train_step(
 ):
     """Build ``train_step(params, opt_state, batch, step_key) ->
     (params, opt_state, loss)``.
+
+    ``tx`` may be an ``optax.GradientTransformation`` OR a
+    ``DistributedOptimizer``/``BasicOptimizer`` — with a DistributedOptimizer
+    the step scales the loss by the live loss scale before ``grad``,
+    unscales/clips/skips inside ``dopt.step``, and reports the UNSCALED
+    loss, so mixed-precision overflow protection needs no hand wiring
+    (examples/resilient_train shows the manual equivalent).
 
     ``loss_fn(logits_or_outputs, batch)`` computes the scalar loss from the
     model output.  Dropout etc. draw from ``step_key`` folded per stream —
@@ -45,10 +52,16 @@ def make_train_step(
     ``loss_fn`` must be MEAN-reduced for step-1 equivalence (a sum-reduced
     loss would be scaled by 1/grad_accum_steps).
     """
+    from .parallel.optimizer import BasicOptimizer, DistributedOptimizer
+
     if has_aux and grad_accum_steps > 1:
         raise NotImplementedError("has_aux with grad accumulation")
+    dopt = tx if isinstance(tx, (BasicOptimizer, DistributedOptimizer)) else None
+    if isinstance(tx, DistributedOptimizer) and has_aux:
+        # the loss-scaling path has no aux plumbing; BasicOptimizer is fine
+        raise NotImplementedError("has_aux with a DistributedOptimizer step")
 
-    def micro_loss(p, micro_batch, step_key):
+    def micro_loss(p, micro_batch, step_key, opt_state=None):
         rngs = (
             {name: jax.random.fold_in(step_key, i) for i, name in enumerate(rng_streams)}
             if step_key is not None
@@ -57,7 +70,10 @@ def make_train_step(
         out = dmodel.apply(
             {"params": p}, micro_batch["input"], deterministic=step_key is None, rngs=rngs
         )
-        return loss_fn(out, micro_batch)
+        loss = loss_fn(out, micro_batch)
+        if isinstance(dopt, DistributedOptimizer) and opt_state is not None:
+            return dopt.scale_loss(loss, opt_state)
+        return loss
 
     def step(params, opt_state, batch, step_key=None):
         if grad_accum_steps <= 1:
@@ -66,7 +82,9 @@ def make_train_step(
                     lambda p: micro_loss(p, batch, step_key), has_aux=True
                 )(params)
             else:
-                loss, grads = jax.value_and_grad(lambda p: micro_loss(p, batch, step_key))(params)
+                loss, grads = jax.value_and_grad(
+                    lambda p: micro_loss(p, batch, step_key, opt_state)
+                )(params)
                 aux = None
         else:
             b0 = jax.tree_util.tree_leaves(batch)[0].shape[0]
@@ -83,7 +101,7 @@ def make_train_step(
                 g_acc, l_acc = carry
                 mb, i = inputs
                 key_i = jax.random.fold_in(step_key, 1000 + i) if step_key is not None else None
-                l, g = jax.value_and_grad(lambda p: micro_loss(p, mb, key_i))(params)
+                l, g = jax.value_and_grad(lambda p: micro_loss(p, mb, key_i, opt_state))(params)
                 g_acc = jax.tree_util.tree_map(lambda a, b: a + b.astype(a.dtype), g_acc, g)
                 return (g_acc, l_acc + l), None
 
@@ -96,6 +114,16 @@ def make_train_step(
             )
             loss = l_sum / grad_accum_steps
             aux = None
+        if dopt is not None:
+            new_params, new_opt_state = dopt.step(params, opt_state, grads)
+            if isinstance(dopt, DistributedOptimizer):
+                # report the UNSCALED loss (pre-step scale — the one
+                # micro_loss multiplied by; the post-step scale differs on
+                # backoff/growth steps)
+                loss = loss / dopt.current_scale(opt_state)
+            if has_aux:
+                return new_params, new_opt_state, loss, aux
+            return new_params, new_opt_state, loss
         updates, new_opt_state = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         if has_aux:
