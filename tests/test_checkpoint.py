@@ -443,6 +443,35 @@ def test_checkpoint_manager_reascend_after_rollback(tmp_path, mesh1d):
     assert not os.path.exists(mgr.step_path(200))
 
 
+def test_async_save_failure_surfaces(tmp_path, mesh1d, monkeypatch):
+    """regression: a failed fire-and-forget async save must not look
+    committed — no meta.json, handle.failed set, wait() re-raises, and the
+    manager drops the dead handle instead of tracking it forever."""
+    import os
+    import time
+
+    from vescale_tpu.checkpoint.storage import FileSystemStorage
+
+    orig = FileSystemStorage.write_bytes
+
+    def failing(self, name, data):
+        if name.startswith("data/"):
+            raise IOError("disk full (injected)")
+        return orig(self, name, data)
+
+    monkeypatch.setattr(FileSystemStorage, "write_bytes", failing)
+    monkeypatch.setenv("VESCALE_NATIVE_CKPT_IO", "0")  # route through python io
+    d = vt.distribute_tensor(np.arange(16, dtype=np.float32), mesh1d, [Shard(0)])
+    h = ckpt.save(str(tmp_path / "fail"), {"m": {"x": d}}, async_checkpoint=True)
+    deadline = time.time() + 20
+    while time.time() < deadline and not h.failed:
+        time.sleep(0.1)
+    assert h.failed
+    with pytest.raises(IOError):
+        h.wait()
+    assert not os.path.exists(tmp_path / "fail" / "meta.json")
+
+
 def test_native_ckpt_writer(tmp_path, mesh1d, monkeypatch):
     """The C++ chunk writer (checkpoint/native/ckpt_io.cpp) builds, writes
     atomically (tmp+fsync+rename), and the python pool takes over when

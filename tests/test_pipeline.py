@@ -514,6 +514,48 @@ def test_zb_v2_engine_parity():
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
 
+def test_interleaved_partial_tail_wave_rejected():
+    """regression: M % S != 0 makes the Megatron wave order dependency-
+    INFEASIBLE (stage 0 would issue a tail microbatch's next chunk before
+    its previous chunk cleared the pipeline) — previously a runtime engine
+    deadlock / simulation RuntimeError, now a clear error; the ZB cost
+    route falls back to the greedy (which handles any M)."""
+    from vescale_tpu.pipe import StageCosts, simulate_schedule, zero_bubble_cost_schedule
+
+    with pytest.raises(ValueError, match="divisible"):
+        interleaved_1f1b_schedule(4, 5, 3)
+    # V=1 interleaved degenerates to plain 1F1B order: any M fine
+    interleaved_1f1b_schedule(4, 5, 1)
+    # cost-graph ZB with V>1 and a partial tail wave: greedy-only, feasible
+    for S, M, V in [(4, 5, 3), (5, 7, 2), (6, 8, 2)]:
+        sched = zero_bubble_cost_schedule(S, M, StageCosts.uniform(S, comm=0.1), virtual_chunks=V)
+        assert simulate_schedule(sched, StageCosts.uniform(S, comm=0.1)) > 0
+        for ins_list in sched:
+            assert len(ins_list) == 3 * M * V
+
+
+def test_zb_greedy_max_inflight_cap():
+    """max_inflight pins the per-stage residual cap (HBM-bound configs):
+    peak forwards-without-wgrad never exceeds it."""
+    from vescale_tpu.pipe import StageCosts, zero_bubble_cost_schedule
+
+    S, M = 4, 16
+    sched = zero_bubble_cost_schedule(
+        S, M, StageCosts.from_weights([1.0, 2.0, 1.0, 3.0], comm=0.2), max_inflight=4
+    )
+    for s, ins_list in enumerate(sched):
+        inflight = peak = 0
+        for ins in ins_list:
+            if ins.kind == InstructionKind.FORWARD:
+                inflight += 1
+            elif ins.kind == InstructionKind.BACKWARD_WGRAD:
+                inflight -= 1
+            peak = max(peak, inflight)
+        assert peak <= 4, (s, peak)
+    with pytest.raises(ValueError, match="V=1"):
+        zero_bubble_cost_schedule(4, 8, None, virtual_chunks=2, max_inflight=4)
+
+
 def test_stage_costs_comm_coerced():
     """np-scalar comm must hash/compare like the equal python float (the
     schedule cache key)."""

@@ -81,17 +81,32 @@ class CheckpointHandle:
     ``wait()`` drains the io workers, then runs the commit step (barrier +
     meta write) on the CALLING thread — a device-collective barrier from an
     io pool thread could interleave with main-thread collectives and
-    deadlock a multi-process run."""
+    deadlock a multi-process run.
+
+    A failed fire-and-forget save records its exception in ``error`` (and
+    warns on stderr); ``wait()`` re-raises it, and the step is never
+    committed — a failed save must not masquerade as a restorable
+    checkpoint."""
 
     def __init__(self, writer: AsyncWriter, commit=None):
         self._writer = writer
         self._commit = commit
         self._done = False
+        self.error: Optional[BaseException] = None
+
+    @property
+    def failed(self) -> bool:
+        return self.error is not None
 
     def wait(self) -> None:
         if self._done:
+            if self.error is not None:
+                raise self.error
             return
         self._writer.shutdown()
+        if self.error is not None:
+            self._done = True
+            raise self.error
         if self._commit is not None:
             self._commit()
         self._done = True
@@ -193,19 +208,29 @@ def save(
         # durable even if the caller never wait()s (round-1 semantics)
         data_futures = list(writer.futures)
 
+        handle = CheckpointHandle(writer)
+
         def _finalize():
-            for f in data_futures:
-                f.result()
-            writer.drain_native()  # meta.json may only chase durable chunks
-            _commit()
-            # fire-and-forget callers never wait(): release the io threads
-            # (wait=False — a worker cannot join its own pool) and the
-            # native pool
-            writer.close_native()
-            writer.pool.shutdown(wait=False)
+            try:
+                for f in data_futures:
+                    f.result()
+                writer.drain_native()  # meta.json may only chase durable chunks
+                _commit()
+            except BaseException as e:  # surface, don't swallow: a failed
+                # fire-and-forget save must not look committed, leak its io
+                # threads, or die silently on a pool future nobody reads
+                handle.error = e
+                import sys as _sys
+
+                print(f"[checkpoint] async save of {path} FAILED: {e!r}", file=_sys.stderr)
+            finally:
+                # fire-and-forget callers never wait(): release the io
+                # threads (wait=False — a worker cannot join its own pool)
+                # and the native pool
+                writer.close_native()
+                writer.pool.shutdown(wait=False)
 
         writer.futures = writer.futures + [writer.pool.submit(_finalize)]
-        handle = CheckpointHandle(writer)
     else:
         # multi-process: the commit includes a device-collective barrier and
         # MUST run on the calling thread — callers must wait() the handle

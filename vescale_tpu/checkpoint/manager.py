@@ -96,29 +96,38 @@ class CheckpointManager:
         # synchronous design has no deferred deletions at all: by the time
         # any later save is requested, the stale dirs are gone.
         rollback = step < self._max_requested
-        # prune finished saves: wait()ed handles, and fire-and-forget ones
-        # whose commit marker already landed
+        # prune finished saves: wait()ed handles, FAILED fire-and-forget
+        # saves (their step never commits — surfaced on stderr by save()),
+        # and ones whose commit marker already landed
         self._pending = {
             s: h
             for s, h in self._pending.items()
-            if not h._done and not os.path.exists(os.path.join(self.step_path(s), "meta.json"))
+            if not h._done
+            and not h.failed
+            and not os.path.exists(os.path.join(self.step_path(s), "meta.json"))
         }
         if rollback:
             # in-flight async saves could still be writing into dirs about
             # to be pruned (their late writers would resurrect them): wait
             # every pending save out, then prune the stale futures NOW
             for s in sorted(self._pending):
-                self._pending.pop(s).wait()
+                try:
+                    self._pending.pop(s).wait()
+                except Exception:
+                    pass  # a failed in-flight save has nothing to resurrect
             if jax.process_index() == 0:
                 for s in self._committed_steps():
                     if s > step:
                         shutil.rmtree(self.step_path(s), ignore_errors=True)
-            # the timeline restarts here: later ascending saves are normal
+            # the timeline restarts here (NOT a dead store: without the
+            # reset, later ascending saves would keep reading as rollbacks
+            # against the old watermark); rollbacks are rare, so committing
+            # synchronously removes the slow-async-rollback-commit race
+            # class
             self._max_requested = step
-            # rollbacks are rare; committing synchronously removes the
-            # whole slow-async-rollback-commit race class
             async_checkpoint = False
-        self._max_requested = max(self._max_requested, step)
+        else:
+            self._max_requested = max(self._max_requested, step)
 
         def _rotate():
             # pure oldest-first keep-K cut: never touches the newest steps,

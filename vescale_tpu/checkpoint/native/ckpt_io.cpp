@@ -115,6 +115,17 @@ struct Pool {
       ::unlink(tmp.c_str());
       return false;
     }
+    // fsync the parent directory: the rename is only durable once the
+    // directory entry is
+    const size_t slash = job.path.rfind('/');
+    if (slash != std::string::npos) {
+      const std::string dir = job.path.substr(0, slash);
+      int dfd = ::open(dir.c_str(), O_RDONLY);
+      if (dfd >= 0) {
+        ::fsync(dfd);
+        ::close(dfd);
+      }
+    }
     return true;
   }
 };

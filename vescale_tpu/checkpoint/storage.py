@@ -55,10 +55,22 @@ class FileSystemStorage(Storage):
         return p
 
     def write_bytes(self, name: str, data: bytes) -> None:
-        tmp = self._p(name) + ".tmp"
+        # fsync BEFORE the rename and fsync the parent dir after: the rename
+        # is the commit point, and the commit protocol (meta.json chases
+        # durable chunks) is void if a power loss can persist the name
+        # without the bytes (or drop the directory entry)
+        path = self._p(name)
+        tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)
-        os.replace(tmp, self._p(name))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        dfd = os.open(os.path.dirname(path), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
 
     def read_bytes(self, name: str) -> bytes:
         with open(os.path.join(self.root, name), "rb") as f:

@@ -103,9 +103,22 @@ def interleaved_1f1b_schedule(
     groups of ``num_stages`` (Megatron ordering).
 
     The generated order is dependency-consistent for the eager engine; exact
-    bubble timing is the compiled path's concern."""
+    bubble timing is the compiled path's concern.
+
+    Requires ``num_microbatches % num_stages == 0`` (Megatron's own
+    constraint): a partial tail wave makes the wave-cycled order
+    dependency-INFEASIBLE — stage 0 would issue the tail microbatch's next
+    chunk before its previous chunk cleared the pipeline, deadlocking the
+    engine.  (The compiled ``pipe.spmd.pipeline_blocks`` path decodes slots
+    per step and has no such restriction.)"""
     F, B = InstructionKind.FORWARD, InstructionKind.BACKWARD
     M, S, V = num_microbatches, num_stages, virtual_chunks
+    if virtual_chunks > 1 and M % S != 0:
+        raise ValueError(
+            f"interleaved 1F1B needs num_microbatches ({M}) divisible by "
+            f"num_stages ({S}) — a partial tail wave deadlocks the schedule "
+            "(Megatron imposes the same constraint)"
+        )
     out = []
     total = M * V
     for s in range(S):
@@ -335,6 +348,7 @@ def _zb_greedy_schedule(
     num_microbatches: int,
     costs: StageCosts,
     virtual_chunks: int = 1,
+    max_inflight: Optional[int] = None,
 ) -> List[List[Instruction]]:
     """Global-clock greedy over the ZB dependency graph: repeatedly start the
     schedulable instruction with the earliest feasible start time, preferring
@@ -349,9 +363,13 @@ def _zb_greedy_schedule(
     may hold at most ``(V-1)*S + 2*(S-s) - 1`` forwards whose WGRAD hasn't
     run — the effective residual depth of the fixed-defer ZB-H1 heuristic
     (its in-flight F-Bd depth ``S-s`` plus its W deferral ``S-s-1``),
-    extended by the VPP warmup term.  A tighter cap starves the warmup and
-    deadlocks V>1; a looser one trades O(M) memory for makespan the way the
-    reference's memory-limited CostGraph deliberately does not."""
+    extended by the VPP warmup term.  This MATCHES the heuristic candidate's
+    own peak (both candidates honor the same contract); note it is ~2x the
+    1F1B in-flight depth ``S-s`` — pass ``max_inflight`` to pin a tighter
+    per-stage cap when HBM is the binding constraint (V=1 only; a cap below
+    the VPP warmup depth deadlocks V>1).  A looser cap trades O(M) memory
+    for makespan the way the reference's memory-limited CostGraph
+    deliberately does not."""
     S, M, V = num_stages, num_microbatches, virtual_chunks
     F, Bd, W = InstructionKind.FORWARD, InstructionKind.BACKWARD_DGRAD, InstructionKind.BACKWARD_WGRAD
     prio = {Bd: 0, F: 1, W: 2}
@@ -361,6 +379,10 @@ def _zb_greedy_schedule(
     bptr = [[0] * V for _ in range(S)]
     wptr = [[0] * V for _ in range(S)]
     cap = [max(1, (V - 1) * S + 2 * (S - s) - 1) for s in range(S)]
+    if max_inflight is not None:
+        if V > 1:
+            raise ValueError("max_inflight caps are V=1 only (VPP warmup needs the default)")
+        cap = [max(1, min(c, max_inflight)) for c in cap]
 
     # Forwards issue in the canonical Megatron wave order (chunks cycle in
     # groups of min(S, M) microbatches — the same order the interleaved
@@ -430,17 +452,28 @@ def _zb_greedy_schedule(
 
 @functools.lru_cache(maxsize=256)
 def _zb_cost_schedule_cached(
-    num_stages: int, num_microbatches: int, costs: StageCosts, virtual_chunks: int = 1
+    num_stages: int,
+    num_microbatches: int,
+    costs: StageCosts,
+    virtual_chunks: int = 1,
+    max_inflight: Optional[int] = None,
 ):
-    if virtual_chunks > 1:
-        # interleaved 1F1B (fused B) is the V>1 heuristic baseline
-        heuristic = interleaved_1f1b_schedule(num_stages, num_microbatches, virtual_chunks)
-    else:
-        heuristic = zero_bubble_schedule(num_stages, num_microbatches)
     cands = [
-        heuristic,
-        _zb_greedy_schedule(num_stages, num_microbatches, costs, virtual_chunks),
+        _zb_greedy_schedule(num_stages, num_microbatches, costs, virtual_chunks, max_inflight)
     ]
+    if max_inflight is None:
+        # the fixed heuristics don't honor a tightened residual cap — only
+        # the capped greedy is a candidate when one is requested
+        if virtual_chunks > 1:
+            if num_microbatches % num_stages == 0:
+                # interleaved 1F1B (fused B) is the V>1 heuristic baseline;
+                # with a partial tail wave its order is infeasible (see
+                # interleaved_1f1b_schedule) — the greedy alone covers that
+                cands.append(
+                    interleaved_1f1b_schedule(num_stages, num_microbatches, virtual_chunks)
+                )
+        else:
+            cands.append(zero_bubble_schedule(num_stages, num_microbatches))
     return min(cands, key=lambda sch: simulate_schedule(sch, costs))
 
 
@@ -449,6 +482,7 @@ def zero_bubble_cost_schedule(
     num_microbatches: int,
     costs: Union[StageCosts, Sequence[float], None] = None,
     virtual_chunks: int = 1,
+    max_inflight: Optional[int] = None,
 ) -> List[List[Instruction]]:
     """Cost-aware zero-bubble schedule (reference CostGraph generator,
     zero_bubble_v.py:198,602): generate candidate schedules — a fixed
@@ -457,9 +491,11 @@ def zero_bubble_cost_schedule(
     return the one with the smallest makespan.
 
     ``costs``: a ``StageCosts``, a per-stage weight sequence (param/FLOP
-    counts — 1:1:1 F:Bd:W assumed), or None (uniform).  Results are memoized
-    per (S, M, costs, V): a training loop re-building its schedule every
-    step pays the Python rollout once."""
+    counts — 1:1:1 F:Bd:W assumed), or None (uniform).  ``max_inflight``
+    (V=1 only) pins a per-stage residual cap below the default ZB-H1 depth
+    for HBM-bound configs (greedy-only: the fixed heuristics don't honor
+    it).  Results are memoized per (S, M, costs, V, cap): a training loop
+    re-building its schedule every step pays the Python rollout once."""
     if costs is None:
         costs = StageCosts.uniform(num_stages)
     elif not isinstance(costs, StageCosts):
@@ -468,7 +504,9 @@ def zero_bubble_cost_schedule(
         raise ValueError(
             f"schedule_costs has {len(costs.f)} stages, plan has {num_stages}"
         )
-    cached = _zb_cost_schedule_cached(num_stages, num_microbatches, costs, virtual_chunks)
+    cached = _zb_cost_schedule_cached(
+        num_stages, num_microbatches, costs, virtual_chunks, max_inflight
+    )
     return [list(stage) for stage in cached]  # callers may mutate their copy
 
 

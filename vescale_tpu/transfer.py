@@ -290,27 +290,16 @@ def ragged_transition_fn(src: DArraySpec, dst: DArraySpec):
     if src_any is not None and dst.is_replicated():
         rj, inner = src_any
         lay = src.layout()
-        cell_pad = lay.cell_pad
-        sizes, offs, total = _ragged_sizes_offsets(src, rj)
-        nj = mesh.shape[rj]
-        s = mesh.shape[inner] if inner is not None else 1
-        shape = src.shape
         rj_name = mesh.dim_name(rj)
         # gather over (inner, rj) — outermost-first, matching the physical
         # block order a*nj + r of the strided-ragged layout
         ax = (mesh.dim_name(inner), rj_name) if inner is not None else rj_name
 
         def worker(x):
+            # gathered g is exactly the full physical flat buffer; the
+            # spec's own unpack owns the block-order reassembly math
             g = jax.lax.all_gather(x, ax, axis=0, tiled=True)  # (s*nj*cell_pad,)
-            out = jnp.zeros((total,), x.dtype)
-            for r in range(nj):
-                cell = sizes[r] // s
-                if cell == 0:
-                    continue
-                for a in range(s):
-                    piece = jax.lax.dynamic_slice(g, ((a * nj + r) * cell_pad,), (cell,))
-                    out = jax.lax.dynamic_update_slice(out, piece, (offs[r] + a * cell,))
-            return jnp.reshape(out, shape)
+            return src._unpack_ragged(g)
 
         fn = shard_map(
             worker,
