@@ -409,6 +409,60 @@ def test_checkpoint_manager_rollback_prunes_stale_futures(tmp_path, mesh1d):
     )
 
 
+def test_checkpoint_manager_same_step_resave_drains_pending(tmp_path, mesh1d):
+    """r4 advisor: re-saving the SAME step while its async save is in flight
+    must not let two writers interleave chunk files in one step dir — the
+    old save is drained (and its dir cleared) before the new one starts, so
+    the committed checkpoint holds exactly the second save's content."""
+    import time
+
+    from vescale_tpu.checkpoint.manager import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ss"), keep=3)
+    x = np.arange(8, dtype=np.float32)
+
+    def st(v):
+        return {"m": {"x": vt.distribute_tensor(x + v, mesh1d, [Shard(0)])}}
+
+    h1 = mgr.save(5, st(1), async_checkpoint=True)
+    assert h1 is not None
+    h2 = mgr.save(5, st(2), async_checkpoint=True)  # same step, new content
+    # save() drained any in-flight first save and un-committed the dir
+    # before letting the second save's writers start; after the second save
+    # commits, the dir must hold exactly the second save's content
+    if h2 is not None:
+        h2.wait()
+    deadline = time.time() + 30
+    while time.time() < deadline and mgr.latest_step() != 5:
+        time.sleep(0.2)  # fire-and-forget commit runs on the io pool
+    tmpl = {"m": {"x": vt.distribute_tensor(np.zeros(8, np.float32), mesh1d, [Shard(0)])}}
+    np.testing.assert_array_equal(
+        np.asarray(mgr.restore(tmpl, step=5)["m"]["x"].full_tensor()), x + 2
+    )
+
+
+def test_load_strict_false_keeps_template_for_new_keys(tmp_path, mesh1d):
+    """forward-compat: a template that grew a state field AFTER the
+    checkpoint was written (e.g. r5's loss_scale/skip_count) restores with
+    strict=False, keeping the template's value for the missing key; the
+    default strict=True still raises."""
+    import vescale_tpu.checkpoint as ckpt
+
+    x = np.arange(8, dtype=np.float32)
+    ckpt.save(str(tmp_path / "old"), {"opt": {"scale": vt.distribute_tensor(x, mesh1d, [Shard(0)])}})
+    tmpl = {
+        "opt": {
+            "scale": vt.distribute_tensor(np.zeros(8, np.float32), mesh1d, [Shard(0)]),
+            "skip_count": np.asarray(7, np.int32),  # new field, not in ckpt
+        }
+    }
+    with pytest.raises(KeyError):
+        ckpt.load(str(tmp_path / "old"), tmpl)
+    out = ckpt.load(str(tmp_path / "old"), tmpl, strict=False)
+    np.testing.assert_array_equal(np.asarray(out["opt"]["scale"].full_tensor()), x)
+    assert int(out["opt"]["skip_count"]) == 7  # template value survived
+
+
 def test_checkpoint_manager_reascend_after_rollback(tmp_path, mesh1d):
     """regression: after a rollback save, later ASCENDING saves are normal
     saves — the rollback's deletion set is fixed at request time and the

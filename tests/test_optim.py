@@ -141,6 +141,40 @@ def test_found_inf_skip_step_and_dynamic_scale(mesh1d):
     assert "loss_scale" not in stn  # static scale carries no state
 
 
+def test_dynamic_scale_floor_and_skip_counter(mesh1d):
+    """r4 advisor: persistent overflows must not decay the scale to 0 (which
+    would turn every later step into 0*inf = NaN grads, silently skipping
+    forever); the scale clamps at min_scale and consecutive skips are
+    counted so a stalled run is observable."""
+    from jax.sharding import PartitionSpec as P
+
+    params = {"w": jnp.ones((4, 4), jnp.float32)}
+    dopt = DistributedOptimizer(
+        optax.sgd(1e-2),
+        mesh1d,
+        {"w": P()},
+        dp_dims=("tp",),
+        loss_scale="dynamic",
+        init_scale=4.0,
+        min_scale=1.0,
+    )
+    state = jax.jit(dopt.init)(params)
+    assert int(state["loss_scale"]["skip_count"]) == 0
+    step = jax.jit(dopt.step)
+    bad = {"w": jnp.full((4, 4), jnp.inf, jnp.float32)}
+
+    # 4.0 -> 2.0 -> 1.0 -> stays 1.0 (floor); skip_count climbs each time
+    for i, want_scale in enumerate([2.0, 1.0, 1.0, 1.0]):
+        params, state = step(params, state, bad)
+        assert float(state["loss_scale"]["scale"]) == want_scale
+        assert int(state["loss_scale"]["skip_count"]) == i + 1
+    # at the floor, scale_loss still yields a usable (nonzero) scaled loss
+    assert float(dopt.scale_loss(jnp.asarray(3.0), state)) == 3.0
+    # a clean step resets the counter
+    params, state = step(params, state, {"w": jnp.ones((4, 4), jnp.float32)})
+    assert int(state["loss_scale"]["skip_count"]) == 0
+
+
 def test_make_train_step_with_distributed_optimizer(mesh2d):
     """make_train_step accepts a DistributedOptimizer directly: the loss is
     scaled before grad, unscaled in the report, and the skip-step machinery

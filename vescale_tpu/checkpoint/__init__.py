@@ -98,18 +98,48 @@ class CheckpointHandle:
     def failed(self) -> bool:
         return self.error is not None
 
+    def drain(self) -> None:
+        """Join every io worker of this save — even a FAILED one — so no
+        late chunk write can land after the caller reuses or clears the
+        target dir.  Never raises: a failed save's error is already
+        recorded (``error``); this only stops its writers."""
+        try:
+            self._writer.pool.shutdown(wait=True)
+        except Exception:
+            pass
+        try:
+            self._writer.drain_native()
+        except Exception:
+            pass
+        try:
+            self._writer.close_native()
+        except Exception:
+            pass
+
     def wait(self) -> None:
         if self._done:
             if self.error is not None:
                 raise self.error
             return
-        self._writer.shutdown()
-        if self.error is not None:
-            self._done = True
-            raise self.error
+        # A local write failure must NOT skip the commit step: in a
+        # multi-process run the commit contains the cross-process success
+        # vote, and a process that bails before it leaves the healthy
+        # processes blocked in the collective forever.  Record the error,
+        # vote ok=False, then raise.
+        try:
+            self._writer.shutdown()
+        except BaseException as e:
+            if self.error is None:
+                self.error = e
         if self._commit is not None:
-            self._commit()
+            try:
+                self._commit(ok=self.error is None)
+            except BaseException as e:
+                if self.error is None:
+                    self.error = e
         self._done = True
+        if self.error is not None:
+            raise self.error
 
 
 def _writer_process(leaf, owner, chunk_idx: int, nproc: int, proc_of: Dict[int, int]) -> int:
@@ -192,11 +222,21 @@ def save(
     # data chunk (on every process) is durable.  The commit runs on the
     # CALLING thread via CheckpointHandle.wait (barrier is a device
     # collective — never issue it from an io worker thread).
-    def _commit():
+    def _commit(ok: bool = True):
         if nproc > 1:
-            from ..distributed import barrier
+            # success vote doubles as the pre-commit barrier: every process
+            # enters it even after a local write failure (wait() passes
+            # ok=False), so a failed save errors everywhere instead of
+            # hanging the healthy processes at a mismatched barrier
+            from ..distributed import all_processes_ok
 
-            barrier(f"ckpt_save:{path}")
+            if not all_processes_ok(ok, f"ckpt_save:{path}"):
+                raise RuntimeError(
+                    f"checkpoint save {path}: a process reported a write "
+                    "failure; not committing"
+                )
+        elif not ok:
+            raise RuntimeError(f"checkpoint save {path}: write failure; not committing")
         if me == 0:
             storage.write_bytes("meta.json", json.dumps(meta).encode())
         if on_commit is not None:
@@ -336,12 +376,22 @@ def _load_jax_array(entry, reader: _ChunkReader, target: jax.Array):
     return jax.make_array_from_callback(shape, target.sharding, cb)
 
 
-def load(path: str, checkpoint_state: Dict[str, Any], broadcast_checkpoint: bool = False) -> Dict[str, Any]:
+def load(
+    path: str,
+    checkpoint_state: Dict[str, Any],
+    broadcast_checkpoint: bool = False,
+    strict: bool = True,
+) -> Dict[str, Any]:
     """Load into the layout described by ``checkpoint_state`` (a template
     pytree of DArray/jax.Array/np leaves — values are ignored, shardings are
     the contract).  Returns a new state dict with loaded values
     (reference load, checkpoint/__init__.py:35; online reshard per
     README.md:37-41).
+
+    ``strict=False`` keeps the TEMPLATE value for keys the checkpoint does
+    not have — the forward-compat escape hatch when new state fields (e.g.
+    the r5 ``loss_scale/skip_count`` counter) are added after a checkpoint
+    was written.  A missing key under ``strict=True`` raises.
 
     Scale contract: for DArray / sharded jax.Array targets, each process
     reads only the saved chunks intersecting its ADDRESSABLE shards and
@@ -360,6 +410,9 @@ def load(path: str, checkpoint_state: Dict[str, Any], broadcast_checkpoint: bool
         for kp, leaf in flat_with_path[0]:
             full_key = f"{top_key}/{key_of_path(kp)}"
             if full_key not in meta["arrays"]:
+                if not strict:
+                    leaves.append(leaf)  # keep the template's value
+                    continue
                 raise KeyError(f"checkpoint at {path} has no array {full_key}")
             entry = meta["arrays"][full_key]
             if isinstance(leaf, DArray):

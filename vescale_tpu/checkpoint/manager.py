@@ -53,6 +53,12 @@ class CheckpointManager:
         # the on-disk newer steps as stale futures when it next saves
         committed = self._committed_steps()
         self._max_requested = committed[-1] if committed else -1
+        # deterministic step history: committed on disk at construction +
+        # every step requested through this manager since.  Identical on
+        # every process (same disk seed, same save-call sequence), so the
+        # re-save/rollback cleanup decisions below never depend on racy
+        # filesystem state.
+        self._known_steps = set(committed)
         self._pending: Dict[int, CheckpointHandle] = {}  # in-flight async saves
 
     # ------------------------------------------------------------- paths
@@ -70,6 +76,17 @@ class CheckpointManager:
             if m and os.path.exists(os.path.join(self.root, e, "meta.json")):
                 out.append(int(m.group(1)))
         return sorted(out)
+
+    def _uncommit(self, step: int) -> None:
+        """Make ``step`` torn-invisible, then clear its dir (process 0 only;
+        callers barrier afterwards in multi-process runs)."""
+        if jax.process_index() != 0:
+            return
+        try:
+            os.remove(os.path.join(self.step_path(step), "meta.json"))
+        except OSError:
+            pass
+        shutil.rmtree(self.step_path(step), ignore_errors=True)
 
     def latest_step(self) -> Optional[int]:
         """Newest step with a COMMITTED checkpoint (meta.json present);
@@ -98,36 +115,82 @@ class CheckpointManager:
         rollback = step < self._max_requested
         # prune finished saves: wait()ed handles, FAILED fire-and-forget
         # saves (their step never commits — surfaced on stderr by save()),
-        # and ones whose commit marker already landed
-        self._pending = {
-            s: h
-            for s, h in self._pending.items()
-            if not h._done
-            and not h.failed
-            and not os.path.exists(os.path.join(self.step_path(s), "meta.json"))
-        }
+        # and ones whose commit marker already landed.  A failed save is
+        # DRAINED before it is dropped: its surviving io workers could
+        # otherwise keep writing stale chunks into a dir a later save of
+        # the same step is about to clear and refill.
+        pending: Dict[int, CheckpointHandle] = {}
+        for s, h in self._pending.items():
+            if h.failed:
+                h.drain()
+                continue
+            if h._done or os.path.exists(os.path.join(self.step_path(s), "meta.json")):
+                continue
+            pending[s] = h
+        self._pending = pending
+        # Same-step re-save detection rides ONLY on deterministic manager
+        # history (`_known_steps`: committed on disk at init, or requested
+        # through this manager since) — never on raw dir existence.  In a
+        # multi-process run the step dir appears the moment ANOTHER
+        # process's writers start on the same (first) save, and checking
+        # existence would also race process 0's cleanup rmtree below,
+        # leaving a slow process outside the resave barrier (deadlock).
+        if not rollback and step in self._known_steps:
+            # re-saving the SAME step — in flight or already on disk.  Two
+            # writers interleaving chunk files in one step_N dir (or new
+            # chunks landing under a LIVE old meta.json) would let a crash
+            # mid-save read as a committed checkpoint with mixed content.
+            # Drain any in-flight save, un-commit (meta.json goes first, so
+            # the dir is torn-invisible from here on), clear the dir on one
+            # process, and sync before any new writer starts.
+            if step in self._pending:
+                h = self._pending.pop(step)
+                try:
+                    h.wait()
+                except Exception:
+                    pass  # a failed save left no commit marker; overwrite freely
+                h.drain()  # wait() raises on first error; join stragglers too
+            self._uncommit(step)
+            if jax.process_count() > 1:
+                from ..distributed import barrier
+
+                barrier(f"ckpt_resave:{step}")
         if rollback:
             # in-flight async saves could still be writing into dirs about
             # to be pruned (their late writers would resurrect them): wait
             # every pending save out, then prune the stale futures NOW
             for s in sorted(self._pending):
+                h = self._pending.pop(s)
                 try:
-                    self._pending.pop(s).wait()
+                    h.wait()
                 except Exception:
-                    pass  # a failed in-flight save has nothing to resurrect
+                    pass  # its step never commits, but its workers must
+                h.drain()  # ...still be joined or they resurrect pruned dirs
             if jax.process_index() == 0:
                 for s in self._committed_steps():
                     if s > step:
                         shutil.rmtree(self.step_path(s), ignore_errors=True)
+            # a rollback can land ON a previously committed step number
+            # (same save cadence after resume): its dir must be un-committed
+            # too, or the new chunks write under the LIVE old meta.json and
+            # a crash mid-save restores silently mixed timelines
+            if step in self._known_steps:
+                self._uncommit(step)
+            if jax.process_count() > 1:
+                from ..distributed import barrier
+
+                barrier(f"ckpt_rollback:{step}")
             # the timeline restarts here (NOT a dead store: without the
             # reset, later ascending saves would keep reading as rollbacks
             # against the old watermark); rollbacks are rare, so committing
             # synchronously removes the slow-async-rollback-commit race
             # class
             self._max_requested = step
+            self._known_steps = {s for s in self._known_steps if s < step}
             async_checkpoint = False
         else:
             self._max_requested = max(self._max_requested, step)
+        self._known_steps.add(step)
 
         def _rotate():
             # pure oldest-first keep-K cut: never touches the newest steps,
@@ -153,11 +216,18 @@ class CheckpointManager:
         return handle
 
     # ----------------------------------------------------------- restore
-    def restore(self, checkpoint_state: Dict[str, Any], step: Optional[int] = None) -> Dict[str, Any]:
+    def restore(
+        self,
+        checkpoint_state: Dict[str, Any],
+        step: Optional[int] = None,
+        strict: bool = True,
+    ) -> Dict[str, Any]:
         """Load the given (default: latest committed) step into the
-        template's layout — the reshard-on-load path of ``load``."""
+        template's layout — the reshard-on-load path of ``load``.
+        ``strict=False`` keeps template values for keys the checkpoint
+        predates (see ``load``)."""
         if step is None:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(f"no committed checkpoint under {self.root}")
-        return load(self.step_path(step), checkpoint_state)
+        return load(self.step_path(step), checkpoint_state, strict=strict)

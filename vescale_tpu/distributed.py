@@ -29,6 +29,7 @@ __all__ = [
     "process_index",
     "process_count",
     "barrier",
+    "all_processes_ok",
     "hybrid_device_mesh",
 ]
 
@@ -84,6 +85,24 @@ def barrier(tag: str = "vescale_barrier") -> None:
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(tag)
+
+
+def all_processes_ok(ok: bool, tag: str = "vescale_ok") -> bool:
+    """Cross-process AND of a local success flag; doubles as a barrier.
+
+    The agreement step a commit protocol needs so one process's failure
+    surfaces as an error EVERYWHERE instead of a barrier mismatch that
+    hangs the healthy processes forever."""
+    if jax.process_count() == 1:
+        return bool(ok)
+    from jax.experimental import multihost_utils
+
+    # tagged sync first: two processes voting at DIFFERENTLY-tagged points
+    # (e.g. commits of two different checkpoints) must fail fast, not pair
+    # their votes up silently — process_allgather itself carries no tag
+    multihost_utils.sync_global_devices(tag)
+    flags = multihost_utils.process_allgather(np.asarray([1 if ok else 0], np.int32))
+    return bool(np.all(flags))
 
 
 def hybrid_device_mesh(

@@ -223,6 +223,7 @@ class DistributedOptimizer:
         growth_interval: int = 2000,
         growth_factor: float = 2.0,
         backoff_factor: float = 0.5,
+        min_scale: float = 1.0,
         skip_nonfinite: Optional[bool] = None,
         **_: Any,
     ):
@@ -236,6 +237,14 @@ class DistributedOptimizer:
         self.growth_interval = int(growth_interval)
         self.growth_factor = float(growth_factor)
         self.backoff_factor = float(backoff_factor)
+        # floor under persistent overflows: without it the scale decays to 0,
+        # scale_loss zeroes the loss, inv becomes inf, grads32 = 0*inf = NaN,
+        # and training silently skips every step forever (r4 advisor finding).
+        # Clamped to init_scale so a sub-unity init_scale cannot make an
+        # overflow RAISE the scale to the floor; must stay > 0 to be a floor.
+        if float(min_scale) <= 0.0:
+            raise ValueError(f"min_scale must be > 0, got {min_scale}")
+        self.min_scale = min(float(min_scale), float(init_scale))
         # skip-step on non-finite grads is implied by loss scaling; it can
         # also be enabled standalone (bf16-without-scaling runs)
         self.skip_nonfinite = bool(loss_scale is not None) if skip_nonfinite is None else skip_nonfinite
@@ -261,6 +270,9 @@ class DistributedOptimizer:
             state["loss_scale"] = {
                 "scale": jnp.asarray(self.init_scale, jnp.float32),
                 "growth_count": jnp.asarray(0, jnp.int32),
+                # consecutive skipped steps — a stalled run (every step
+                # overflowing at the floor) is observable instead of silent
+                "skip_count": jnp.asarray(0, jnp.int32),
             }
         return state
 
@@ -315,12 +327,15 @@ class DistributedOptimizer:
             grown = growth >= self.growth_interval
             scale = jnp.where(
                 overflow,
-                ls["scale"] * self.backoff_factor,
+                jnp.maximum(ls["scale"] * self.backoff_factor, self.min_scale),
                 jnp.where(grown, ls["scale"] * self.growth_factor, ls["scale"]),
             )
             out_state["loss_scale"] = {
                 "scale": scale,
                 "growth_count": jnp.where(grown, 0, growth).astype(jnp.int32),
+                "skip_count": jnp.where(
+                    overflow, ls.get("skip_count", jnp.asarray(0, jnp.int32)) + 1, 0
+                ).astype(jnp.int32),
             }
         elif "loss_scale" in opt_state:
             out_state["loss_scale"] = opt_state["loss_scale"]
