@@ -231,7 +231,26 @@ def main():
     n = len(devices)
     on_tpu = devices[0].platform == "tpu"
 
-    if on_tpu:
+    rung = os.environ.get("VESCALE_BENCH_RUNG", "1.3b")
+    if on_tpu and rung == "350m":
+        # fallback rung when the 1.3B child fails on the live chip (OOM /
+        # flaky tunnel mid-run): the round-1 driver-verified config — a
+        # smaller footprint gives the round SOME fresh TPU number rather
+        # than none (VERDICT r4 next #3)
+        B, T = 1, 4096
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=1024,
+            intermediate_size=2816,
+            num_hidden_layers=24,
+            num_attention_heads=16,
+            num_key_value_heads=8,
+            max_position_embeddings=T,
+            dtype=jnp.bfloat16,
+            use_flash_attention=True,
+        )
+        metric = "llama350m_train_MFU_1chip_seq4096"
+    elif on_tpu:
         # B=1 WITHOUT remat beats B=2 with full remat (0.712 vs 0.595 MFU
         # measured): 1.26B params + bf16 adam moments + one batch of
         # activations fit in 15.75 GB, so no forward is recomputed.  B=2
@@ -445,15 +464,17 @@ def _probe_default_backend(timeout: float) -> int:
     return 0
 
 
-def _run_child(deadline: float, force_cpu: bool = False) -> bool:
-    """Run the selected bench in a child process; True iff it succeeded AND
-    printed the JSON line.  The child (not this parent) risks backend-init
-    hangs.  The matched line is BUFFERED and forwarded only on success — a
-    child that prints its number then crashes must not emit, or the retry
-    would print a second line and break the driver's ONE-JSON-line contract
-    (ADVICE r3 medium, bench.py:397)."""
+def _run_child(deadline: float, force_cpu: bool = False, rung: str = None):
+    """Run the selected bench in a child process; returns the parsed metric
+    dict on success, None otherwise.  The child (not this parent) risks
+    backend-init hangs.  The matched line is BUFFERED and emitted by the
+    ORCHESTRATOR only on success — a child that prints its number then
+    crashes must not emit, or the retry would print a second line and break
+    the driver's ONE-JSON-line contract (ADVICE r3 medium, bench.py:397)."""
     env = dict(os.environ)
     env["VESCALE_BENCH_CHILD"] = "1"
+    if rung:
+        env["VESCALE_BENCH_RUNG"] = rung
     code = "import bench; bench._dispatch()"
     if force_cpu:
         env["JAX_PLATFORMS"] = "cpu"
@@ -478,11 +499,56 @@ def _run_child(deadline: float, force_cpu: bool = False) -> bool:
         if matched:
             print(f"[bench] child printed a metric line but exited rc={rc}; "
                   "discarding it (failed run)", file=sys.stderr)
-        return False
+        return None
     if not matched:
-        return False
-    print(matched[-1])
-    return True
+        return None
+    try:
+        return json.loads(matched[-1])
+    except ValueError:
+        print(f"[bench] child metric line is not valid JSON: {matched[-1][:200]}",
+              file=sys.stderr)
+        return None
+
+
+LASTGOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "TPU_LASTGOOD.json")
+
+
+def _bench_mode() -> str:
+    return os.environ.get("VESCALE_BENCH") or "default"
+
+
+def _read_lastgood_file() -> dict:
+    try:
+        with open(LASTGOOD_PATH) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    # r5 pre-keyed format: a single {"record": ...} blob was the default
+    # llama bench's record
+    return {"default": data} if "record" in data else data
+
+
+def _save_lastgood(line: dict) -> None:
+    """Persist a fresh on-TPU result, keyed by bench mode (the default
+    llama ladder, moe, longctx each keep their own record — a moe number
+    must never surface as the llama ladder's last-known result), so
+    TPU-outage rounds can still report the newest driver-verifiable number
+    (VERDICT r4 next #3)."""
+    data = _read_lastgood_file()
+    data[_bench_mode()] = {
+        "record": line,
+        "recorded": time.strftime("%Y-%m-%d"),
+        "provenance": "bench.py on the live chip",
+    }
+    try:
+        with open(LASTGOOD_PATH, "w") as f:
+            json.dump(data, f, indent=2)
+    except OSError as e:
+        print(f"[bench] could not persist last-good TPU record: {e}", file=sys.stderr)
+
+
+def _load_lastgood():
+    return _read_lastgood_file().get(_bench_mode())
 
 
 def _orchestrate() -> int:
@@ -501,6 +567,7 @@ def _orchestrate() -> int:
         print("[bench] another orchestrator is live; skipping stale-holder "
               "cleanup", file=sys.stderr)
     attempt = 0
+    tpu_children_failed = 0
     while time.time() < deadline - cpu_reserve:
         attempt += 1
         _kill_stale_holders()
@@ -510,12 +577,36 @@ def _orchestrate() -> int:
                   file=sys.stderr)
             time.sleep(min(15.0 * attempt, 45.0))
             continue
-        if _run_child(deadline - cpu_reserve):
+        # headline 1.3B rung first; if the live chip keeps failing it (OOM,
+        # tunnel flake mid-run), drop to the smaller driver-verified 350M
+        # rung — a fresh small number beats no fresh number.  Only the
+        # default llama bench reads VESCALE_BENCH_RUNG: for moe/longctx a
+        # "fallback" would silently re-run the identical failing config.
+        fallback_ok = not os.environ.get("VESCALE_BENCH")
+        rung = "350m" if fallback_ok and tpu_children_failed >= 2 else None
+        line = _run_child(deadline - cpu_reserve, rung=rung)
+        if line is not None:
+            if "cpu" not in str(line.get("metric", "")):
+                _save_lastgood(line)
+            print(json.dumps(line))
             return 0
-        print(f"[bench] attempt {attempt}: bench child failed; retrying", file=sys.stderr)
+        tpu_children_failed += 1
+        print(f"[bench] attempt {attempt}: bench child failed; retrying"
+              + (" on the 350m fallback rung" if tpu_children_failed >= 2 else ""),
+              file=sys.stderr)
         time.sleep(min(10.0 * attempt, 30.0))
     print("[bench] TPU unavailable within budget; emitting CPU fallback line", file=sys.stderr)
-    return 0 if _run_child(deadline, force_cpu=True) else 1
+    line = _run_child(deadline, force_cpu=True)
+    if line is None:
+        return 1
+    # surface the newest driver-verifiable TPU number alongside the CPU
+    # smoke, honestly labelled stale — a TPU-outage round must never leave
+    # the record with ONLY a CPU line (VERDICT r4 next #3)
+    lastgood = _load_lastgood()
+    if lastgood is not None:
+        line["last_known_tpu"] = {**lastgood, "stale": True}
+    print(json.dumps(line))
+    return 0
 
 
 if __name__ == "__main__":

@@ -213,6 +213,44 @@ def test_spmd_pipeline_blocks(mesh1d):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
 
 
+def test_pipeline_blocks_auto_act_spec_parity():
+    """r5: auto_act_spec pins the microbatch stash / carries / backward
+    stash to a dp x tp activation layout on the AUTO axes (the 405B
+    memory-fit knob, AOT_405B_REPORT.json) without changing values — fwd
+    and grads match the unconstrained pipeline bitwise-ish."""
+    from jax.sharding import PartitionSpec as P
+
+    from vescale_tpu.pipe.spmd import pipeline_blocks, stack_stage_params
+
+    mesh = vt.DeviceMesh(("pp", "dp", "tp"), (2, 2, 2))
+    W = jax.random.normal(jax.random.key(1), (2, 3, 16, 16)) * 0.1  # (S, L, E, E)
+    x = jax.random.normal(jax.random.key(2), (4, 8, 16))  # (B, T, E)
+
+    def block_fn(stage_w, xm):
+        def body(h, w):
+            return jnp.tanh(h @ w), None
+
+        out, _ = jax.lax.scan(body, xm, stage_w)
+        return out
+
+    def run(**kw):
+        def loss(W, x):
+            return jnp.sum(
+                pipeline_blocks(block_fn, W, x, mesh, num_microbatches=2, **kw) ** 2
+            )
+
+        # partial-auto shard_map (manual pp, auto dp/tp) requires jit
+        out = jax.jit(
+            lambda W, x: pipeline_blocks(block_fn, W, x, mesh, num_microbatches=2, **kw)
+        )(W, x)
+        return out, jax.jit(jax.grad(loss))(W, x)
+
+    base_out, base_g = run()
+    sp_out, sp_g = run(auto_act_spec=P("dp", "tp"))
+    np.testing.assert_allclose(np.asarray(sp_out), np.asarray(base_out), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(sp_g), np.asarray(base_g), rtol=1e-5, atol=1e-5)
+
+
 def test_params_split_tail_heavy():
     """regression: PARAMETERS split with weight concentrated in last units."""
     from vescale_tpu.pipe.pipe_stage import _cuts_by_weight

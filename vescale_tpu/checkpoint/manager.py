@@ -77,16 +77,34 @@ class CheckpointManager:
                 out.append(int(m.group(1)))
         return sorted(out)
 
-    def _uncommit(self, step: int) -> None:
-        """Make ``step`` torn-invisible, then clear its dir (process 0 only;
-        callers barrier afterwards in multi-process runs)."""
-        if jax.process_index() != 0:
+    @staticmethod
+    def _fsync_dir(path: str) -> None:
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
             return
         try:
-            os.remove(os.path.join(self.step_path(step), "meta.json"))
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def _uncommit(self, step: int) -> None:
+        """Make ``step`` torn-invisible, then clear its dir (process 0 only;
+        callers barrier afterwards in multi-process runs).  The meta.json
+        unlink is fsynced before the dir is cleared: to the same power-loss
+        standard the commit path holds (storage.py fsyncs file + parent
+        dir), or a replayed journal could resurrect the OLD meta.json over
+        the NEW chunk files the next save writes under the same names."""
+        if jax.process_index() != 0:
+            return
+        step_dir = self.step_path(step)
+        try:
+            os.remove(os.path.join(step_dir, "meta.json"))
         except OSError:
             pass
-        shutil.rmtree(self.step_path(step), ignore_errors=True)
+        self._fsync_dir(step_dir)
+        shutil.rmtree(step_dir, ignore_errors=True)
+        self._fsync_dir(self.root)
 
     def latest_step(self) -> Optional[int]:
         """Newest step with a COMMITTED checkpoint (meta.json present);
@@ -144,12 +162,11 @@ class CheckpointManager:
             # the dir is torn-invisible from here on), clear the dir on one
             # process, and sync before any new writer starts.
             if step in self._pending:
-                h = self._pending.pop(step)
-                try:
-                    h.wait()
-                except Exception:
-                    pass  # a failed save left no commit marker; overwrite freely
-                h.drain()  # wait() raises on first error; join stragglers too
+                # drain WITHOUT committing: the in-flight save is doomed
+                # (its dir is cleared next), and actively committing it
+                # would fire on_commit rotation — pruning an old step on
+                # the strength of a checkpoint about to be deleted
+                self._pending.pop(step).drain()
             self._uncommit(step)
             if jax.process_count() > 1:
                 from ..distributed import barrier
@@ -161,11 +178,17 @@ class CheckpointManager:
             # every pending save out, then prune the stale futures NOW
             for s in sorted(self._pending):
                 h = self._pending.pop(s)
+                if s > step:
+                    # doomed stale future: join its writers, never commit it
+                    # (a commit would fire rotation against a dir pruned on
+                    # the next line)
+                    h.drain()
+                    continue
                 try:
-                    h.wait()
-                except Exception:
-                    pass  # its step never commits, but its workers must
-                h.drain()  # ...still be joined or they resurrect pruned dirs
+                    h.wait()  # a real checkpoint below the rollback point:
+                except Exception:  # commit it before the timeline restarts
+                    pass
+                h.drain()  # wait() raises on first error; join stragglers
             if jax.process_index() == 0:
                 for s in self._committed_steps():
                     if s > step:

@@ -168,6 +168,7 @@ def pipeline_blocks(
     num_microbatches: Optional[int] = None,
     extra_specs: Optional[P] = None,
     virtual_chunks: int = 1,
+    auto_act_spec: Optional[P] = None,
 ):
     """Apply ``S * virtual_chunks`` sequential model chunks (V per pp-mesh
     rank, Megatron interleaved assignment) to ``x``, pipelined over
@@ -179,6 +180,14 @@ def pipeline_blocks(
     (``stack_interleaved_params``), sharded on ``pp``.  ``x``: (B, ...) with
     B divisible by num_microbatches.  Returns (B, ...) outputs (as if the
     chunks were applied sequentially).
+
+    ``auto_act_spec``: PartitionSpec over the AUTO (non-pp) mesh axes for
+    one microbatch activation ``(b, *features)`` — e.g. ``P("dp", "tp")``
+    for the Megatron-SP layout (batch over dp, sequence over tp).  Without
+    it GSPMD chooses; with it the microbatch stash, the rotating carry, the
+    outs buffer, and every scan-saved boundary (the backward stash) are
+    pinned to that sharding — at 405B scale the difference between a 68 GB
+    and a 1 GB per-device activation footprint.
     """
     S, M, B, xm, act_spec, manual = _prepare(
         x, mesh, pp_dim, num_microbatches, virtual_chunks, extra_specs, stacked_params
@@ -186,13 +195,25 @@ def pipeline_blocks(
     V = virtual_chunks
     T = _vpp_total_steps(S, V, M)
 
+    def constrain(z, lead: int = 0):
+        # pin an activation buffer to auto_act_spec on the AUTO axes (legal
+        # inside the pp-manual shard_map: dp/tp/... stay GSPMD-managed).
+        # A bare PartitionSpec resolves against the CONTEXT mesh, whose
+        # axis types are (Manual, Auto, ...) here — a NamedSharding built
+        # from the concrete mesh would carry all-Auto types and trip the
+        # context-mesh check when sharding propagates (zeros_like etc.)
+        if auto_act_spec is None:
+            return z
+        spec = P(*((None,) * lead + tuple(auto_act_spec)))
+        return jax.lax.with_sharding_constraint(z, spec)
+
     def worker(params, xm_local):
         # leaves (V, ...): the local stage's chunks
         idx = jax.lax.axis_index(pp_dim)
         perm = [(i, (i + 1) % S) for i in range(S)]
-        micro = xm_local  # (M, b, ...)
+        micro = constrain(xm_local, lead=1)  # (M, b, ...)
         outs0 = jnp.zeros_like(micro)
-        act0 = jnp.zeros_like(micro[0])
+        act0 = constrain(jnp.zeros_like(micro[0]))
 
         def body(carry, t):
             act, outs = carry
@@ -201,7 +222,7 @@ def pipeline_blocks(
             x_in = jnp.where(
                 inject, jax.lax.dynamic_index_in_dim(micro, mc, 0, keepdims=False), act
             )
-            y = block_fn(_index_chunk(params, v, V), x_in)
+            y = constrain(block_fn(_index_chunk(params, v, V), x_in))
             outs = jax.lax.dynamic_update_index_in_dim(
                 outs,
                 jnp.where(
