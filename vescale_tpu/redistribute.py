@@ -63,20 +63,110 @@ def redistribute(darr: DArray, placements, mesh: Optional[DeviceMesh] = None) ->
 
     # Per-shard transition kernels (transfer.py): each rank touches only its
     # shard; the collective is the exact reference-table op (all-gather /
-    # reduce-scatter / all-to-all / all-gather-v / all-to-all-v) — no
-    # logical-size allocation.
-    from .transfer import fallback_fn, ragged_transition_fn, transition_fn
+    # reduce-scatter / all-to-all / all-gather-v / all-to-all-v /
+    # interleaved piece-exchange) — no logical-size allocation.
+    from .transfer import (
+        fallback_fn,
+        interleaved_transition_fn,
+        ragged_transition_fn,
+        transition_fn,
+    )
 
     fn = transition_fn(src, dst)
     if fn is None and (src.has_ragged() or dst.has_ragged()):
         fn = ragged_transition_fn(src, dst)
+    if fn is None and (src.layout().interleaves or dst.layout().interleaves):
+        fn = interleaved_transition_fn(src, dst)
     if fn is not None:
         return DArray(fn(darr.data), dst)
 
-    # fallback (ragged / interleaved / nested / cross-mesh): pack∘unpack,
-    # jit-compiled with the destination sharding where possible
+    # cross-mesh without logical materialization: strip each side to a
+    # plain physical==logical form with SAME-mesh per-shard kernels, then
+    # let the runtime reshard device-to-device (jax.device_put between
+    # shardings copies shards, reference CrossMeshRedistribute
+    # redistribute.py:562 — which round-trips through the logical value;
+    # this path never does)
+    if dst_mesh != darr.mesh:
+        out = _cross_mesh_per_shard(darr, src, dst)
+        if out is not None:
+            return out
+
+    # fallback (nested shards, exotic cross-mesh): pack∘unpack, jit-compiled
+    # with the destination sharding where possible.  The logical value may
+    # materialize: surface that loudly (VERDICT r4 next #9) and hard-fail
+    # under VESCALE_STRICT_REDISTRIBUTE=1.
+    _warn_fallback(src, dst)
     phys = fallback_fn(src, dst)(darr.data)
     return DArray(_apply_sharding(phys, dst), dst)
+
+
+def _plain_placements(spec: DArraySpec):
+    """Same-mesh placements with physical==logical semantics: interleaves
+    become plain shards, partials reduce to Replicate.  None when the spec
+    is out of scope (ragged) or the plain form still pads."""
+    from .placements import InterleavedShard, Replicate as R, Shard as S
+
+    if spec.has_ragged():
+        return None
+    out = []
+    for p in spec.placements:
+        if isinstance(p, InterleavedShard):
+            out.append(S(p.dim))
+        elif p.is_partial():
+            out.append(R())
+        else:
+            out.append(p)
+    return tuple(out)
+
+
+def _cross_mesh_per_shard(darr: DArray, src: DArraySpec, dst: DArraySpec) -> Optional[DArray]:
+    src_plain = _plain_placements(src)
+    dst_plain = _plain_placements(dst)
+    if src_plain is None or dst_plain is None:
+        return None
+    mid_spec = DArraySpec(src.mesh, src_plain, src.meta)
+    dst_mid_spec = DArraySpec(dst.mesh, dst_plain, dst.meta)
+    # both plain forms must BE the logical array shard-wise (no padding/
+    # interleave left), or device_put would move a padded physical layout
+    # into a differently-padded one
+    for s in (mid_spec, dst_mid_spec):
+        if s.layout().any_padded or s.layout().interleaves or s.has_partial():
+            return None
+    mid = darr if mid_spec == src else redistribute(darr, src_plain)
+    data = jax.device_put(mid.data, dst_mid_spec.named_sharding())
+    out = DArray(data, dst_mid_spec)
+    return out if dst_mid_spec == dst else redistribute(out, dst.placements)
+
+
+_warned_pairs = set()
+
+
+def _warn_fallback(src: DArraySpec, dst: DArraySpec) -> None:
+    import os
+    import warnings
+
+    from .debug import DebugLogger
+
+    itemsize = jax.numpy.dtype(src.dtype).itemsize
+    logical = itemsize
+    for s in src.shape:
+        logical *= s
+    shard = max(
+        logical // max(1, src.mesh.size()), logical // max(1, dst.mesh.size())
+    )
+    msg = (
+        f"redistribute fallback for {src.placements} -> {dst.placements} "
+        f"(mesh {src.mesh.mesh_dim_names}{'->' + str(dst.mesh.mesh_dim_names) if dst.mesh != src.mesh else ''}) "
+        f"may materialize the LOGICAL tensor: ~{logical / 2**20:.1f} MiB vs "
+        f"~{shard / 2**20:.1f} MiB per-shard"
+    )
+    if os.environ.get("VESCALE_STRICT_REDISTRIBUTE", "0").lower() not in ("", "0", "false"):
+        raise RuntimeError(msg + " (VESCALE_STRICT_REDISTRIBUTE=1)")
+    key = (src, dst)
+    if key not in _warned_pairs:
+        _warned_pairs.add(key)
+        warnings.warn(msg, stacklevel=3)
+    DebugLogger.log("redistribute", msg)
 
 
 def redistribute_local_tensor(locals_, src_spec: DArraySpec, dst_spec: DArraySpec, rank: int = 0):

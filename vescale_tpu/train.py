@@ -51,15 +51,16 @@ def make_train_step(
     The accumulated grads/loss are averaged over micro-batches, so
     ``loss_fn`` must be MEAN-reduced for step-1 equivalence (a sum-reduced
     loss would be scaled by 1/grad_accum_steps).
+
+    ``has_aux=True``: ``loss_fn`` returns ``(loss, aux)`` — a metrics pytree
+    carried through every path (r5, VERDICT r4 next #8): with a
+    DistributedOptimizer only the LOSS is scaled (aux stays raw), and under
+    grad accumulation float aux leaves are MEAN-reduced across micro-batches
+    while integer leaves (counts) are SUMMED.
     """
     from .parallel.optimizer import BasicOptimizer, DistributedOptimizer
 
-    if has_aux and grad_accum_steps > 1:
-        raise NotImplementedError("has_aux with grad accumulation")
     dopt = tx if isinstance(tx, (BasicOptimizer, DistributedOptimizer)) else None
-    if isinstance(tx, DistributedOptimizer) and has_aux:
-        # the loss-scaling path has no aux plumbing; BasicOptimizer is fine
-        raise NotImplementedError("has_aux with a DistributedOptimizer step")
 
     def micro_loss(p, micro_batch, step_key, opt_state=None):
         rngs = (
@@ -70,16 +71,24 @@ def make_train_step(
         out = dmodel.apply(
             {"params": p}, micro_batch["input"], deterministic=step_key is None, rngs=rngs
         )
-        loss = loss_fn(out, micro_batch)
+        res = loss_fn(out, micro_batch)
+        loss, aux = res if has_aux else (res, None)
         if isinstance(dopt, DistributedOptimizer) and opt_state is not None:
-            return dopt.scale_loss(loss, opt_state)
-        return loss
+            loss = dopt.scale_loss(loss, opt_state)
+        return (loss, aux) if has_aux else loss
+
+    def _reduce_aux_leaf(a):
+        # a: (grad_accum_steps, ...) stacked metric — means for measures,
+        # sums for integer counts
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            return jnp.mean(a, axis=0).astype(a.dtype)
+        return jnp.sum(a, axis=0)
 
     def step(params, opt_state, batch, step_key=None):
         if grad_accum_steps <= 1:
             if has_aux:
                 (loss, aux), grads = jax.value_and_grad(
-                    lambda p: micro_loss(p, batch, step_key), has_aux=True
+                    lambda p: micro_loss(p, batch, step_key, opt_state), has_aux=True
                 )(params)
             else:
                 loss, grads = jax.value_and_grad(
@@ -101,19 +110,27 @@ def make_train_step(
                 g_acc, l_acc = carry
                 mb, i = inputs
                 key_i = jax.random.fold_in(step_key, 1000 + i) if step_key is not None else None
-                l, g = jax.value_and_grad(lambda p: micro_loss(p, mb, key_i, opt_state))(params)
+                if has_aux:
+                    (l, aux_i), g = jax.value_and_grad(
+                        lambda p: micro_loss(p, mb, key_i, opt_state), has_aux=True
+                    )(params)
+                else:
+                    l, g = jax.value_and_grad(lambda p: micro_loss(p, mb, key_i, opt_state))(params)
+                    aux_i = None
                 g_acc = jax.tree_util.tree_map(lambda a, b: a + b.astype(a.dtype), g_acc, g)
-                return (g_acc, l_acc + l), None
+                return (g_acc, l_acc + l), aux_i
 
             g0 = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            (g_sum, l_sum), _ = jax.lax.scan(
+            (g_sum, l_sum), aux_stack = jax.lax.scan(
                 accum, (g0, 0.0), (micros, jnp.arange(grad_accum_steps))
             )
             grads = jax.tree_util.tree_map(
                 lambda g, p: (g / grad_accum_steps).astype(p.dtype), g_sum, params
             )
             loss = l_sum / grad_accum_steps
-            aux = None
+            aux = (
+                jax.tree_util.tree_map(_reduce_aux_leaf, aux_stack) if has_aux else None
+            )
         if dopt is not None:
             new_params, new_opt_state = dopt.step(params, opt_state, grads)
             if isinstance(dopt, DistributedOptimizer):
