@@ -185,6 +185,20 @@ def save(
     ``on_commit``: called (on whatever thread runs the commit) right after
     meta.json lands — fire-and-forget async callers get an exact
     commit-time hook (CheckpointManager rotation) without polling."""
+    from ..ndtimeline.api import ndtimeit
+    from ..ndtimeline.predefined import CHECKPOINT_SAVE
+
+    with ndtimeit(CHECKPOINT_SAVE, tags={"path": path, "async": async_checkpoint}):
+        return _save_impl(path, checkpoint_state, async_checkpoint, num_io_workers, on_commit)
+
+
+def _save_impl(
+    path: str,
+    checkpoint_state: Dict[str, Any],
+    async_checkpoint: bool,
+    num_io_workers: int,
+    on_commit,
+) -> Optional[CheckpointHandle]:
     storage = _storage_for(path)
     writer = AsyncWriter(storage, num_io_workers)
     meta: Dict[str, Any] = {"arrays": {}}
@@ -223,6 +237,13 @@ def save(
     # CALLING thread via CheckpointHandle.wait (barrier is a device
     # collective — never issue it from an io worker thread).
     def _commit(ok: bool = True):
+        from ..ndtimeline.api import ndtimeit
+        from ..ndtimeline.predefined import CHECKPOINT_COMMIT
+
+        with ndtimeit(CHECKPOINT_COMMIT, tags={"path": path}):
+            _commit_impl(ok)
+
+    def _commit_impl(ok: bool):
         if nproc > 1:
             # success vote doubles as the pre-commit barrier: every process
             # enters it even after a local write failure (wait() passes
@@ -396,6 +417,14 @@ def load(
     Scale contract: for DArray / sharded jax.Array targets, each process
     reads only the saved chunks intersecting its ADDRESSABLE shards and
     never materializes the full logical array (see ``LAST_LOAD_STATS``)."""
+    from ..ndtimeline.api import ndtimeit
+    from ..ndtimeline.predefined import CHECKPOINT_LOAD
+
+    with ndtimeit(CHECKPOINT_LOAD, tags={"path": path}):
+        return _load_impl(path, checkpoint_state, strict)
+
+
+def _load_impl(path: str, checkpoint_state: Dict[str, Any], strict: bool) -> Dict[str, Any]:
     storage = _storage_for(path)
     LAST_LOAD_STATS.update(bytes_read=0, files_read=0)  # reset: a failed
     # load must not leave the previous load's stats looking current

@@ -9,7 +9,16 @@ from typing import Optional
 from .timer import NDTimerManager
 from .world_info import WorldInfo
 
-__all__ = ["init_ndtimers", "flush", "wait", "inc_step", "ndtimeit", "ndtimer", "get_manager"]
+__all__ = [
+    "init_ndtimers",
+    "flush",
+    "wait",
+    "inc_step",
+    "ndtimeit",
+    "ndtimer",
+    "get_manager",
+    "is_active",
+]
 
 _MANAGER: Optional[NDTimerManager] = None
 
@@ -47,9 +56,23 @@ def inc_step(n: int = 1) -> None:
     get_manager().inc_step(n)
 
 
+def is_active() -> bool:
+    """True once ``init_ndtimers`` (or any ``get_manager`` call) ran —
+    the gate the runtime's auto-instrumentation checks so un-profiled
+    production runs pay nothing."""
+    return _MANAGER is not None
+
+
 def ndtimeit(metric: str, tags=None):
-    """Context manager: with ndtimeit("forward-compute"): ..."""
-    return get_manager().timeit(metric, tags)
+    """Context manager: with ndtimeit("forward-compute"): ...
+
+    A no-op (``nullcontext``) until the profiler is initialized: the
+    runtime wiring (pipe engine, train step, checkpoint) calls this on
+    every operation, and dormant instrumentation must not build
+    TraceAnnotations, take locks, or grow a ring buffer nobody flushes."""
+    if _MANAGER is None:
+        return contextlib.nullcontext()
+    return _MANAGER.timeit(metric, tags)
 
 
 def ndtimer(metric: str):

@@ -11,7 +11,7 @@ from typing import Dict, List
 
 from .timer import Span
 
-__all__ = ["parse_raw_spans", "aggregate"]
+__all__ = ["parse_raw_spans", "aggregate", "merge_ranks"]
 
 
 def parse_raw_spans(path: str) -> List[Span]:
@@ -32,6 +32,29 @@ def parse_raw_spans(path: str) -> List[Span]:
                     tags=d.get("tags"),
                 )
             )
+    return out
+
+
+def merge_ranks(spans: List[Span]) -> Dict[tuple, Dict[str, float]]:
+    """Cross-rank merge keyed by (step, metric) — the reference parser's
+    per-(rank, step, metric) join (legacy parser_handler.py) rolled up so
+    stragglers are visible: per-rank totals, cross-rank mean/max and the
+    max/mean imbalance ratio.  Feed it the concatenation of every rank's
+    ``parse_raw_spans`` output."""
+    cell: Dict[tuple, Dict[int, float]] = {}
+    for s in spans:
+        cell.setdefault((s.step, s.metric), {}).setdefault(s.rank, 0.0)
+        cell[(s.step, s.metric)][s.rank] += s.duration * 1e3
+    out: Dict[tuple, Dict[str, float]] = {}
+    for key, per_rank in cell.items():
+        vals = list(per_rank.values())
+        mean = statistics.fmean(vals)
+        out[key] = {
+            "per_rank_ms": dict(sorted(per_rank.items())),
+            "mean_ms": mean,
+            "max_ms": max(vals),
+            "imbalance": (max(vals) / mean) if mean > 0 else 1.0,
+        }
     return out
 
 

@@ -242,6 +242,18 @@ class PipeEngine:
         # round-robin clock over stages, dependency-driven (the reference's
         # per-rank executors run concurrently; single-controller execution
         # needs only the dependency order)
+        import contextlib
+
+        from ..ndtimeline import predefined as _metrics
+        from ..ndtimeline.api import is_active, ndtimeit
+
+        _nd_active = is_active()  # snapshot: dormant profiler costs nothing
+        _metric_of = {
+            InstructionKind.FORWARD: _metrics.FORWARD_COMPUTE,
+            InstructionKind.BACKWARD: _metrics.BACKWARD_COMPUTE,
+            InstructionKind.BACKWARD_DGRAD: _metrics.BACKWARD_COMPUTE,
+            InstructionKind.BACKWARD_WGRAD: _metrics.WGRAD_COMPUTE,
+        }
         timer = self.on_instruction
         queues = [list(s) for s in schedule]
         pos = [0] * len(queues)
@@ -250,13 +262,34 @@ class PipeEngine:
             for s, q in enumerate(queues):
                 if pos[s] < len(q) and ready(q[pos[s]]):
                     ins = q[pos[s]]
+                    # auto-instrumentation (reference predefined.py spans
+                    # around the pipe runtime): every instruction emits an
+                    # ndtimeline span tagged (stage, chunk, microbatch) when
+                    # the profiler is initialized.  NOTE host-side region:
+                    # it brackets dispatch (async) unless profiling mode
+                    # blocks below.
+                    span = (
+                        ndtimeit(
+                            _metric_of.get(ins.kind, str(ins.kind)),
+                            tags={
+                                "stage": ins.stage,
+                                "chunk": ins.chunk,
+                                "microbatch": ins.microbatch,
+                                "dgrad": ins.kind == InstructionKind.BACKWARD_DGRAD,
+                            },
+                        )
+                        if _nd_active
+                        else contextlib.nullcontext()
+                    )
                     if timer is None:
-                        run(ins)
+                        with span:
+                            run(ins)
                     else:
                         # every profiled instruction is blocked, so the device
                         # queue is empty at start: wall time == own duration
                         t0 = time.perf_counter()
-                        jax.block_until_ready(run(ins))
+                        with span:
+                            jax.block_until_ready(run(ins))
                         timer(ins, time.perf_counter() - t0)
                     pos[s] += 1
                     progressed = True
