@@ -1,0 +1,128 @@
+"""2-process COMPILED pipeline worker (VERDICT r4 next #6).
+
+A pp(DCN) x dp(ICI) mesh spans both processes; the compiled ppermute
+pipeline (pipe/spmd.py pipeline_blocks) runs fwd+bwd across the process
+boundary under one jit, checked against an in-jit sequential golden; the
+pp-stacked stage params then round-trip through a per-process distributed
+checkpoint save + reshard load.
+
+Mirrors the reference's multi-rank pipeline e2e
+(legacy/test/parallel/pipeline/e2e/test_pp_accuracy_alignment.py) on the
+spawned-OS-process CPU rig.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import vescale_tpu.distributed as vdist  # noqa: E402
+
+vdist.initialize()
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+import vescale_tpu.checkpoint as ckpt  # noqa: E402
+from vescale_tpu.pipe.spmd import pipeline_blocks  # noqa: E402
+
+me = vdist.process_index()
+assert vdist.process_count() == 2
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+# pp spans the TWO PROCESSES (the DCN axis — a real cross-host pipeline
+# boundary); dp stays within a process (ICI)
+mesh = vdist.hybrid_device_mesh(("pp", "dp"), ici_shape=(4,), dcn_shape=(2,))
+assert mesh.shape == (2, 4)
+devs = mesh.jax_mesh.devices
+assert {d.process_index for d in devs[0]} != {d.process_index for d in devs[1]}
+
+S, Lps, E, B, T, M = 2, 2, 16, 8, 4, 4
+rng = np.random.default_rng(0)
+Wnp = (rng.normal(size=(S, Lps, E, E)) * 0.2).astype(np.float32)
+xnp = rng.normal(size=(B, T, E)).astype(np.float32)
+
+mk = jax.make_array_from_callback
+W = mk(Wnp.shape, NamedSharding(mesh.jax_mesh, P("pp")), lambda i: Wnp[i])
+x = mk(xnp.shape, NamedSharding(mesh.jax_mesh, P("dp")), lambda i: xnp[i])
+
+
+def block_fn(stage_w, xm):
+    def body(h, w):
+        return jnp.tanh(h @ w), None
+
+    out, _ = jax.lax.scan(body, xm, stage_w)
+    return out
+
+
+def pipe_loss(W, x):
+    return jnp.sum(
+        pipeline_blocks(
+            block_fn, W, x, mesh, num_microbatches=M, auto_act_spec=P("dp")
+        )
+        ** 2
+    )
+
+
+def seq_loss(W, x):
+    # sequential golden computed inside the SAME jit (replicated math)
+    h = x
+    for s in range(S):
+        h = block_fn(W[s], h)
+    return jnp.sum(h**2)
+
+
+@jax.jit
+def check(W, x):
+    lp, gp = jax.value_and_grad(pipe_loss)(W, x)
+    ls, gs = jax.value_and_grad(seq_loss)(W, x)
+    return (
+        jnp.abs(lp - ls),
+        jnp.max(jnp.abs(gp - gs)),
+    )
+
+
+dl, dg = check(W, x)
+assert float(dl) < 1e-3, float(dl)
+assert float(dg) < 1e-4, float(dg)
+
+# ---- checkpoint round-trip of the pp-stacked stage params: per-process
+# writes (each process owns its pp stage's chunks), then a reshard load
+ck_dir = sys.argv[1]
+ckpt.save(ck_dir, {"pipe": {"W": W}})
+vdist.barrier("after_pipe_save")
+if me == 0:
+    wdir = os.path.join(ck_dir, "data", "pipe", "W")
+    assert len(os.listdir(wdir)) == 2, os.listdir(wdir)  # one chunk per stage
+
+# local-only reload into the SAME pp layout: each process reads only its half
+reloaded = ckpt.load(ck_dir, {"pipe": {"W": W}})
+stats = dict(ckpt.LAST_LOAD_STATS)
+assert stats["bytes_read"] <= Wnp.nbytes // 2 + 4096, (stats, Wnp.nbytes)
+
+# reshard load: stages come back replicated over pp, sharded over dp rows
+tmpl = mk(
+    Wnp.shape,
+    NamedSharding(mesh.jax_mesh, P(None, None, "dp")),
+    lambda i: np.zeros((S, Lps, E // 4, E), np.float32),
+)
+loaded = ckpt.load(ck_dir, {"pipe": {"W": tmpl}})
+
+
+@jax.jit
+def maxdiff(a, b):
+    return jnp.abs(a - b).max()
+
+
+assert float(maxdiff(loaded["pipe"]["W"], W)) < 1e-6
+
+# the resharded params still drive the pipeline to the same loss
+dl2, dg2 = check(loaded["pipe"]["W"], x)
+assert float(dl2) < 1e-3 and float(dg2) < 1e-4
+
+vdist.barrier("done")
+print(f"OK proc {me}")

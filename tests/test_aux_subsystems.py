@@ -16,6 +16,19 @@ from vescale_tpu.placements import Replicate, Shard
 
 
 # ------------------------------------------------------------ VeDeviceMesh
+
+@pytest.fixture(autouse=True)
+def _nd_profiler_reset():
+    """The runtime auto-instrumentation gates on the GLOBAL ndtimeline
+    manager: reset it after every test in this module (exception-safe) so a
+    profiling test can never leak live instrumentation into later tests."""
+    yield
+    from vescale_tpu.ndtimeline import api as nd
+
+    nd._MANAGER = None
+    nd._ACTIVE = False
+
+
 def test_vedevicemesh_api():
     from vescale_tpu.devicemesh_api import VeDeviceMesh
 
@@ -534,6 +547,9 @@ def test_ndtimeline_runtime_wiring_fast():
 
     # dormant profiler: ndtimeit is a nullcontext, nothing recorded
     nd._MANAGER = None
+    nd._ACTIVE = False
+    # a stray get_manager()/flush() must NOT activate instrumentation
+    nd.get_manager()
     assert not nd.is_active()
     import contextlib
 
@@ -561,4 +577,3 @@ def test_ndtimeline_runtime_wiring_fast():
         ckpt.save(td + "/ck", {"m": {"x": vt.distribute_tensor(np.arange(8, dtype=np.float32), mesh, [Shard(0)])}})
     names = {s.metric for s in mgr.flush()}
     assert {"train-step", "checkpoint-save", "checkpoint-commit"} <= names, names
-    nd._MANAGER = None  # leave the global profiler dormant for other tests

@@ -1,0 +1,210 @@
+"""fp8 quantized training (r5, VERDICT r4 next #7; SURVEY.md:17 new-gen
+scope): the functional delayed-scaling core (quant/fp8.py) and the
+module-level Llama path (LlamaConfig.use_fp8 via flax Fp8DotGeneralOp +
+make_train_step's _overwrite_with_gradient handling)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+
+import vescale_tpu as vt
+from vescale_tpu.quant import (
+    Fp8DotState,
+    fp8_dot,
+    init_fp8_dot_state,
+    merge_fp8_state,
+)
+
+OWG = "_overwrite_with_gradient"
+
+
+def test_fp8_dot_quantization_accuracy():
+    """fp8_dot approximates the exact matmul to e4m3 precision once the
+    delayed scale has seen the data's range."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 64)).astype(np.float32)
+    w = (rng.normal(size=(64, 16)) * 0.1).astype(np.float32)
+    state = init_fp8_dot_state()
+    # step 1 runs at scale 1.0 (empty history); afterwards the scale is
+    # calibrated to the observed amax
+    y1, state = fp8_dot(jnp.asarray(x), jnp.asarray(w), state)
+    y2, state = fp8_dot(jnp.asarray(x), jnp.asarray(w), state)
+    exact = x @ w
+    rel = np.abs(np.asarray(y2) - exact) / (np.abs(exact) + 1e-3)
+    assert float(np.median(rel)) < 0.05, float(np.median(rel))
+    # amax histories recorded the operands
+    np.testing.assert_allclose(float(state.x.amax_history[0]), np.abs(x).max(), rtol=1e-6)
+    np.testing.assert_allclose(float(state.w.amax_history[0]), np.abs(w).max(), rtol=1e-6)
+
+
+def test_fp8_dot_grad_state_threading():
+    """The gradient-side amax arrives as the STATE's cotangent; grads of
+    x/w approximate the exact ones; merge_fp8_state composes fwd + bwd."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+    w = jnp.asarray((rng.normal(size=(16, 4)) * 0.2).astype(np.float32))
+    state = init_fp8_dot_state(history_len=4)
+
+    def loss(x, w, st):
+        y, st2 = fp8_dot(x, w, st)
+        return jnp.sum(jnp.sin(y)), st2
+
+    (l, st_fwd), (gx, gw, gst) = jax.value_and_grad(loss, argnums=(0, 1, 2), has_aux=True)(
+        x, w, state
+    )
+    # exact reference grads
+    gl = jax.grad(lambda x, w: jnp.sum(jnp.sin(x @ w)), argnums=(0, 1))(x, w)
+    for a, b in zip((gx, gw), gl):
+        denom = jnp.abs(b) + 1e-2
+        assert float(jnp.median(jnp.abs(a - b) / denom)) < 0.1
+    merged = merge_fp8_state(st_fwd, gst)
+    assert float(merged.g.amax_history[0]) > 0.0  # cotangent amax recorded
+    assert float(merged.x.amax_history[0]) == float(jnp.max(jnp.abs(x)))
+
+    # non-finite cotangent amax is dropped by the finite guard
+    bad = Fp8DotState(
+        gst.x, gst.w, type(gst.g)(gst.g.amax_history.at[0].set(jnp.inf))
+    )
+    safe = merge_fp8_state(st_fwd, bad)
+    assert np.isfinite(np.asarray(safe.g.amax_history)).all()
+
+
+def test_fp8_training_tracks_fp32():
+    """A small regression net trained with fp8_dot tracks the exact-matmul
+    run: same trajectory within a few percent after several steps."""
+    rng = np.random.default_rng(2)
+    Xnp = rng.normal(size=(64, 32)).astype(np.float32)
+    Wtrue = (rng.normal(size=(32, 8)) * 0.5).astype(np.float32)
+    Ynp = (Xnp @ Wtrue + 0.01 * rng.normal(size=(64, 8))).astype(np.float32)
+    W0 = (rng.normal(size=(32, 8)) * 0.1).astype(np.float32)
+    X, Y = jnp.asarray(Xnp), jnp.asarray(Ynp)
+
+    def run(fp8: bool, steps=20):
+        w = jnp.asarray(W0)
+        state = init_fp8_dot_state()
+        tx = optax.sgd(5e-2)
+        opt = tx.init(w)
+        losses = []
+
+        @jax.jit
+        def step(w, opt, state):
+            def loss(w, st):
+                if fp8:
+                    y, st2 = fp8_dot(X, w, st)
+                else:
+                    y, st2 = X @ w, st
+                return jnp.mean((y - Y) ** 2), st2
+
+            (l, st_fwd), (gw, gst) = jax.value_and_grad(loss, argnums=(0, 1), has_aux=True)(
+                w, state
+            )
+            u, opt2 = tx.update(gw, opt, w)
+            return optax.apply_updates(w, u), opt2, merge_fp8_state(st_fwd, gst) if fp8 else state, l
+
+        for _ in range(steps):
+            w, opt, state, l = step(w, opt, state)
+            losses.append(float(l))
+        return losses
+
+    l8 = run(True)
+    l32 = run(False)
+    assert l8[-1] < l8[0] * 0.7  # it trains
+    assert abs(l8[-1] - l32[-1]) / l32[-1] < 0.1, (l8[-1], l32[-1])
+
+
+@pytest.mark.slow
+def test_llama_fp8_e2e_parity(mesh2d):
+    """LlamaConfig.use_fp8 end to end: the OWG collection threads through
+    make_train_step with a DistributedOptimizer (dynamic loss scale), the
+    delayed-scaling histories advance, and the loss trajectory stays within
+    tolerance of the fp32 run — the 350M-rung parity check at test scale."""
+    from vescale_tpu.dmodule import parallelize_module
+    from vescale_tpu.models.llama import Llama, LlamaConfig, llama_plan
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
+    from vescale_tpu.parallel.optimizer import DistributedOptimizer
+    from vescale_tpu.train import make_train_step
+
+    def build(fp8: bool):
+        cfg = LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=32, dtype=jnp.float32,
+            use_flash_attention=False, use_fp8=fp8,
+        )
+        dm = parallelize_module(Llama(cfg), mesh2d, llama_plan(mesh2d))
+        variables = dm.init(jax.random.key(0), jnp.ones((2, 16), jnp.int32))
+        return dm, variables
+
+    toks = np.asarray(
+        np.random.default_rng(3).integers(0, 128, (8, 17)), np.int32
+    )
+    batch = {"input": jnp.asarray(toks[:, :-1]), "target": jnp.asarray(toks[:, 1:])}
+
+    def run(fp8: bool, steps=5, accum=1):
+        dm, variables = build(fp8)
+        params = variables["params"]
+        pspecs = jax.tree_util.tree_map(lambda p: p.sharding.spec, params)
+        dopt = DistributedOptimizer(
+            optax.adamw(3e-3), mesh2d, pspecs, loss_scale="dynamic", init_scale=16.0
+        )
+        state = dopt.init(params)
+        bundle = {"params": params, OWG: variables[OWG]} if fp8 else params
+        step = make_train_step(
+            dm, dopt, lambda lg, b: cross_entropy_loss(lg, b["target"]),
+            donate=False, grad_accum_steps=accum,
+        )
+        losses = []
+        for _ in range(steps):
+            bundle, state, l = step(bundle, state, batch)
+            losses.append(float(l))
+        return losses, bundle, state
+
+    l8, bundle8, st8 = run(True)
+    l32, _, _ = run(False)
+    assert l8[-1] < l8[0], l8  # fp8 trains
+    # parity band: fp8 at toy scale tracks fp32 loosely but monotonically
+    assert abs(l8[-1] - l32[-1]) / l32[-1] < 0.15, (l8, l32)
+    assert float(st8["loss_scale"]["scale"]) >= 16.0  # no spurious overflow
+    # delayed-scaling state advanced: some amax history is non-zero
+    owg_leaves = jax.tree_util.tree_leaves(bundle8[OWG])
+    assert any(float(jnp.max(jnp.abs(l))) > 0 for l in owg_leaves)
+
+    # grad accumulation composes (last-wins OWG update)
+    la, bundle_a, _ = run(True, steps=2, accum=2)
+    assert la[-1] < la[0] * 1.05
+    assert any(
+        float(jnp.max(jnp.abs(l))) > 0
+        for l in jax.tree_util.tree_leaves(bundle_a[OWG])
+    )
+
+
+def test_fp8_mixed_precision_and_scan_layers():
+    """r5 review findings: (1) dw comes back in the WEIGHT's dtype (fp32
+    master weights must not get bf16-rounded grads); (2) use_fp8 composes
+    with scan_layers (the OWG collection scans on the same (L,) axis)."""
+    from vescale_tpu.models.llama import Llama, LlamaConfig
+
+    x = jnp.asarray(np.random.randn(4, 8), jnp.bfloat16)
+    w = jnp.asarray(np.random.randn(8, 4) * 0.2, jnp.float32)
+    st = init_fp8_dot_state()
+
+    def loss(x, w, st):
+        y, _ = fp8_dot(x, w, st)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    gx, gw = jax.grad(loss, argnums=(0, 1))(x, w, st)
+    assert gx.dtype == jnp.bfloat16 and gw.dtype == jnp.float32, (gx.dtype, gw.dtype)
+
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=16, dtype=jnp.float32,
+        use_flash_attention=False, use_fp8=True, scan_layers=True,
+    )
+    v = Llama(cfg).init(jax.random.key(0), jnp.ones((2, 8), jnp.int32))
+    owg_leaves = jax.tree_util.tree_leaves(v[OWG])
+    assert owg_leaves and all(l.shape[0] == 2 for l in owg_leaves)  # (L,) axis
+    out = Llama(cfg).apply(v, jnp.ones((2, 8), jnp.int32))
+    assert out.shape == (2, 8, 64)

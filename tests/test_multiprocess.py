@@ -20,10 +20,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_train_and_checkpoint(tmp_path):
+def _run_two_process_worker(worker_name: str, tmp_path):
     repo = pathlib.Path(__file__).resolve().parent.parent
-    worker = repo / "tests" / "multiproc" / "worker_train_ckpt.py"
+    worker = repo / "tests" / "multiproc" / worker_name
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -63,3 +62,18 @@ def test_two_process_train_and_checkpoint(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-4000:]}"
         assert f"OK proc {pid}" in out
+
+
+@pytest.mark.slow
+def test_two_process_train_and_checkpoint(tmp_path):
+    _run_two_process_worker("worker_train_ckpt.py", tmp_path)
+
+
+@pytest.mark.slow
+def test_two_process_compiled_pipeline(tmp_path):
+    """VERDICT r4 next #6: the COMPILED ppermute pipeline crosses a process
+    boundary — pp spans the two processes (DCN axis), fwd+bwd checked
+    against a sequential golden inside the same jit, and the pp-stacked
+    stage params round-trip through a per-process distributed checkpoint
+    with a reshard load."""
+    _run_two_process_worker("worker_pipeline.py", tmp_path)

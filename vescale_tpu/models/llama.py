@@ -72,6 +72,13 @@ class LlamaConfig:
     # leading (L,) axis — shard them with pipe.spmd.shard_stacked_params or
     # tp-shifted plans (llama_plan(scanned=True)).
     scan_layers: bool = False
+    # fp8 quantized training (SURVEY.md:17 new-gen scope): every projection
+    # matmul runs through flax's Fp8DotGeneralOp — e4m3 fwd / e5m2 grads
+    # with delayed (amax-history) scaling.  Adds an
+    # ``_overwrite_with_gradient`` variable collection (scales + histories)
+    # that make_train_step threads and overwrite-updates automatically; the
+    # functional equivalent for custom training loops is quant/fp8.py.
+    use_fp8: bool = False
     dtype: Any = jnp.bfloat16
 
     def __post_init__(self):
@@ -155,6 +162,14 @@ def rotary(q, k, positions, theta: float):
     return rot(q), rot(k)
 
 
+def _proj_kwargs(c: "LlamaConfig") -> dict:
+    """Extra nn.Dense kwargs for the block projections: fp8 routes the
+    matmul through delayed-scaling Fp8DotGeneralOp (embed/lm_head stay
+    high-precision — standard fp8 recipe keeps the ends of the network
+    out of fp8)."""
+    return {"dot_general_cls": nn.Fp8DotGeneralOp} if c.use_fp8 else {}
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
@@ -163,9 +178,9 @@ class LlamaAttention(nn.Module):
         c = self.config
         B, T, E = x.shape
         H, KV, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
-        q = nn.Dense(H * hd, use_bias=False, dtype=c.dtype, name="q_proj")(x)
-        k = nn.Dense(KV * hd, use_bias=False, dtype=c.dtype, name="k_proj")(x)
-        v = nn.Dense(KV * hd, use_bias=False, dtype=c.dtype, name="v_proj")(x)
+        q = nn.Dense(H * hd, use_bias=False, dtype=c.dtype, name="q_proj", **_proj_kwargs(c))(x)
+        k = nn.Dense(KV * hd, use_bias=False, dtype=c.dtype, name="k_proj", **_proj_kwargs(c))(x)
+        v = nn.Dense(KV * hd, use_bias=False, dtype=c.dtype, name="v_proj", **_proj_kwargs(c))(x)
         q = q.reshape(B, T, H, hd)
         k = k.reshape(B, T, KV, hd)
         v = v.reshape(B, T, KV, hd)
@@ -185,7 +200,7 @@ class LlamaAttention(nn.Module):
             att = jnp.where(mask[None, None], att, jnp.finfo(jnp.float32).min)
             att = jax.nn.softmax(att, axis=-1).astype(c.dtype)
             y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, H * hd)
-        return nn.Dense(E, use_bias=False, dtype=c.dtype, name="o_proj")(y)
+        return nn.Dense(E, use_bias=False, dtype=c.dtype, name="o_proj", **_proj_kwargs(c))(y)
 
 
 class LlamaMLP(nn.Module):
@@ -194,9 +209,9 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         c = self.config
-        g = nn.Dense(c.intermediate_size, use_bias=False, dtype=c.dtype, name="gate_proj")(x)
-        u = nn.Dense(c.intermediate_size, use_bias=False, dtype=c.dtype, name="up_proj")(x)
-        return nn.Dense(c.hidden_size, use_bias=False, dtype=c.dtype, name="down_proj")(
+        g = nn.Dense(c.intermediate_size, use_bias=False, dtype=c.dtype, name="gate_proj", **_proj_kwargs(c))(x)
+        u = nn.Dense(c.intermediate_size, use_bias=False, dtype=c.dtype, name="up_proj", **_proj_kwargs(c))(x)
+        return nn.Dense(c.hidden_size, use_bias=False, dtype=c.dtype, name="down_proj", **_proj_kwargs(c))(
             nn.silu(g) * u
         )
 
@@ -258,7 +273,9 @@ class Llama(nn.Module):
         if c.scan_layers:
             scan = nn.scan(
                 _scan_body(block_cls),
-                variable_axes={"params": 0},
+                # fp8 delayed-scaling state is per-layer too: stack it on the
+                # same leading (L,) axis as the params
+                variable_axes={"params": 0, "_overwrite_with_gradient": 0},
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=c.num_hidden_layers,

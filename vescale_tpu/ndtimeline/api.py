@@ -21,6 +21,9 @@ __all__ = [
 ]
 
 _MANAGER: Optional[NDTimerManager] = None
+_ACTIVE = False  # set ONLY by init_ndtimers: the runtime auto-
+# instrumentation gate.  A stray flush()/inc_step() on an un-profiled run
+# auto-creates a manager (API compat) but must NOT flip instrumentation on.
 
 
 def get_manager() -> NDTimerManager:
@@ -32,7 +35,8 @@ def get_manager() -> NDTimerManager:
 
 def init_ndtimers(rank: int = 0, mesh=None, handlers=(), max_spans: int = 100_000) -> NDTimerManager:
     """(api.py:72) — create the global manager, register handlers."""
-    global _MANAGER
+    global _MANAGER, _ACTIVE
+    _ACTIVE = True
     _MANAGER = NDTimerManager(rank=rank, max_spans=max_spans)
     if mesh is not None:
         _MANAGER.world = WorldInfo.from_mesh(mesh, rank)
@@ -57,20 +61,21 @@ def inc_step(n: int = 1) -> None:
 
 
 def is_active() -> bool:
-    """True once ``init_ndtimers`` (or any ``get_manager`` call) ran —
-    the gate the runtime's auto-instrumentation checks so un-profiled
-    production runs pay nothing."""
-    return _MANAGER is not None
+    """True only after an EXPLICIT ``init_ndtimers`` — the gate the
+    runtime's auto-instrumentation checks so un-profiled production runs
+    pay nothing (a stray ``flush()``/``inc_step()`` must not activate it)."""
+    return _ACTIVE and _MANAGER is not None
 
 
 def ndtimeit(metric: str, tags=None):
     """Context manager: with ndtimeit("forward-compute"): ...
 
-    A no-op (``nullcontext``) until the profiler is initialized: the
-    runtime wiring (pipe engine, train step, checkpoint) calls this on
-    every operation, and dormant instrumentation must not build
-    TraceAnnotations, take locks, or grow a ring buffer nobody flushes."""
-    if _MANAGER is None:
+    A no-op (``nullcontext``) until the profiler is explicitly
+    initialized: the runtime wiring (pipe engine, train step, checkpoint)
+    calls this on every operation, and dormant instrumentation must not
+    build TraceAnnotations, take locks, or grow a ring buffer nobody
+    flushes."""
+    if not is_active():
         return contextlib.nullcontext()
     return _MANAGER.timeit(metric, tags)
 

@@ -1,0 +1,15 @@
+from .fp8 import (
+    Fp8DotState,
+    Fp8TensorState,
+    fp8_dot,
+    init_fp8_dot_state,
+    merge_fp8_state,
+)
+
+__all__ = [
+    "Fp8DotState",
+    "Fp8TensorState",
+    "fp8_dot",
+    "init_fp8_dot_state",
+    "merge_fp8_state",
+]

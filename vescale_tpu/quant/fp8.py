@@ -1,0 +1,178 @@
+"""fp8 quantized training — delayed-scaling float8 matmul (SURVEY.md:17
+new-gen capability set: "RaggedShard ... for FSDP, quantized training,
+Muon-style optimizers"; the reference's scope marker for fp8 training).
+
+TPU-first FUNCTIONAL design: no module state, no dispatch interception —
+an ``fp8_dot`` whose scaling state threads explicitly through the jitted
+step, so it composes with pjit/GSPMD sharding, the compiled pipeline, and
+``jax.grad`` without framework hooks.  (The module-level path —
+``LlamaConfig.use_fp8`` — rides flax's ``Fp8DotGeneralOp`` instead, which
+keeps the same state in the ``_overwrite_with_gradient`` collection;
+``make_train_step`` understands that collection.)
+
+The recipe (standard transformer-engine-style delayed scaling):
+
+  * forward operands quantize to **e4m3** (max 448, more mantissa), the
+    backward cotangent to **e5m2** (max 57344, more range) — gradients
+    need range, activations need precision.
+  * per-tensor scale is DELAYED: computed from a rolling amax history of
+    the last H steps, never from the current tensor — so quantize is a
+    static elementwise op with no data-dependent reduction in front of the
+    matmul (XLA fuses it into the dot's prologue).
+  * the matmul accumulates in fp32 and the result is de-scaled by
+    ``1/(sx*sw)``.
+
+Getting the GRADIENT amax out of backward is done the functional way: the
+state is an ARGUMENT of a ``custom_vjp``, and its cotangent carries the
+updated gradient-side state ("overwrite with gradient" — the same trick
+flax's fp8_ops uses, expressed as plain function composition).  A train
+step therefore:
+
+    (loss, state_fwd), (gp, gstate) = value_and_grad(
+        loss_fn, argnums=(0, 1), has_aux=True)(params, state, batch)
+    state = merge_fp8_state(state_fwd, gstate)   # x/w from fwd, g from bwd
+
+Loss-scaling composition: amax is recorded on the SCALED gradients, so the
+delayed scale absorbs the loss scale automatically; non-finite amax values
+(overflow steps the DistributedOptimizer skips) are dropped by
+``merge_fp8_state``'s finite guard rather than poisoning the history.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Fp8TensorState",
+    "Fp8DotState",
+    "init_fp8_dot_state",
+    "fp8_dot",
+    "merge_fp8_state",
+]
+
+E4M3_MAX = float(jnp.finfo(jnp.float8_e4m3fn).max)  # 448
+E5M2_MAX = float(jnp.finfo(jnp.float8_e5m2).max)    # 57344
+
+
+class Fp8TensorState(NamedTuple):
+    """Delayed-scaling state of ONE tensor slot (x, w, or g)."""
+
+    amax_history: jax.Array  # (H,) fp32, rolling; [0] is most recent
+
+
+class Fp8DotState(NamedTuple):
+    x: Fp8TensorState
+    w: Fp8TensorState
+    g: Fp8TensorState
+
+
+def init_fp8_dot_state(history_len: int = 16) -> Fp8DotState:
+    one = Fp8TensorState(jnp.zeros((history_len,), jnp.float32))
+    return Fp8DotState(one, one, one)
+
+
+def _delayed_scale(st: Fp8TensorState, fp8_max: float) -> jax.Array:
+    """fp8_max / max(history): the scale that would have put the largest
+    recent value at the format edge.  Empty history (all zeros — the first
+    steps) -> scale 1.0."""
+    amax = jnp.max(st.amax_history)
+    return jnp.where(amax > 0.0, fp8_max / amax, 1.0)
+
+
+def _roll(st: Fp8TensorState, amax_now: jax.Array) -> Fp8TensorState:
+    """Push the current amax into the history (finite values only: an
+    overflow step must not poison the delayed scale)."""
+    amax_now = jnp.where(jnp.isfinite(amax_now), amax_now, st.amax_history[0])
+    return Fp8TensorState(jnp.concatenate([amax_now[None], st.amax_history[:-1]]))
+
+
+def _quantize(x, scale, dtype, fp8_max: float):
+    q = jnp.clip(x.astype(jnp.float32) * scale, -fp8_max, fp8_max)
+    return q.astype(dtype)
+
+
+@jax.custom_vjp
+def _fp8_dot_core(x, w, state: Fp8DotState):
+    y, _ = _core_fwd(x, w, state)
+    return y
+
+
+def _core_fwd(x, w, state: Fp8DotState):
+    sx = _delayed_scale(state.x, E4M3_MAX)
+    sw = _delayed_scale(state.w, E4M3_MAX)
+    qx = _quantize(x, sx, jnp.float8_e4m3fn, E4M3_MAX)
+    qw = _quantize(w, sw, jnp.float8_e4m3fn, E4M3_MAX)
+    # fp32 accumulation; on fp8-capable hardware XLA lowers the fp8 x fp8
+    # dot natively, elsewhere it upcasts — numerics (the quantization) are
+    # identical either way
+    y = jnp.dot(
+        qx.astype(jnp.float32), qw.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST
+    ) * (1.0 / (sx * sw))
+    # zero-size dtype sentinels: the primal dtypes must survive into the
+    # backward (dtype objects are not JAX types, so they ride as empty
+    # arrays in the residuals)
+    return y.astype(x.dtype), (
+        qx, qw, sx, sw, jnp.zeros((0,), x.dtype), jnp.zeros((0,), w.dtype), state,
+    )
+
+
+def _core_bwd(res, dy):
+    qx, qw, sx, sw, x_sent, w_sent, state = res
+    x_dtype, w_dtype = x_sent.dtype, w_sent.dtype
+    sg = _delayed_scale(state.g, E5M2_MAX)
+    qg = _quantize(dy, sg, jnp.float8_e5m2, E5M2_MAX)
+    g32 = qg.astype(jnp.float32)
+    dx = (g32 @ qw.astype(jnp.float32).T) * (1.0 / (sg * sw))
+    dw = (qx.astype(jnp.float32).T @ g32) * (1.0 / (sx * sg))
+    # the state's cotangent IS the updated gradient-side state: amax of the
+    # RAW (pre-quantize) cotangent rolls into g's history; x/w slots pass
+    # through unchanged (merge_fp8_state takes them from the forward)
+    g_new = _roll(state.g, jnp.max(jnp.abs(dy.astype(jnp.float32))))
+    dstate = Fp8DotState(state.x, state.w, g_new)
+    # each grad returns in its PRIMAL's dtype: bf16 activations with fp32
+    # master weights must not round dw down to the cotangent's bf16
+    return dx.astype(x_dtype), dw.astype(w_dtype), dstate
+
+
+_fp8_dot_core.defvjp(lambda x, w, s: _core_fwd(x, w, s), _core_bwd)
+
+
+def fp8_dot(x, w, state: Fp8DotState):
+    """``x @ w`` through delayed-scaling fp8 quantization.
+
+    Returns ``(y, state_after_forward)``: the forward-side state has x/w
+    amax histories rolled; the GRADIENT side arrives as ``state``'s
+    cotangent under ``jax.grad`` (see module docstring / merge_fp8_state).
+    ``x``: (..., K) flattened to 2-D for the dot; ``w``: (K, N)."""
+    lead = x.shape[:-1]
+    x2 = x.reshape((-1, x.shape[-1]))
+    y = _fp8_dot_core(x2, w, state)
+    y = y.reshape(lead + (w.shape[-1],))
+    # x/w histories roll in the forward (stop_gradient: bookkeeping, not math)
+    new_state = Fp8DotState(
+        _roll(state.x, jax.lax.stop_gradient(jnp.max(jnp.abs(x2.astype(jnp.float32))))),
+        _roll(state.w, jax.lax.stop_gradient(jnp.max(jnp.abs(w.astype(jnp.float32))))),
+        state.g,
+    )
+    return y, new_state
+
+
+def merge_fp8_state(state_fwd, state_cotangent):
+    """Combine a pytree of forward-updated ``Fp8DotState`` with the same
+    tree's cotangents from ``jax.grad``: x/w slots from the forward, g
+    slots from the cotangent — with a finite guard so an overflow step
+    (skipped by the optimizer) cannot poison the histories."""
+
+    def one(fwd: Fp8DotState, cot: Fp8DotState) -> Fp8DotState:
+        g_hist = jnp.where(jnp.isfinite(cot.g.amax_history), cot.g.amax_history, 0.0)
+        return Fp8DotState(fwd.x, fwd.w, Fp8TensorState(g_hist))
+
+    return jax.tree_util.tree_map(
+        one,
+        state_fwd,
+        state_cotangent,
+        is_leaf=lambda n: isinstance(n, Fp8DotState),
+    )

@@ -59,10 +59,20 @@ def make_train_step(
     DistributedOptimizer only the LOSS is scaled (aux stays raw), and under
     grad accumulation float aux leaves are MEAN-reduced across micro-batches
     while integer leaves (counts) are SUMMED.
+
+    fp8 models (``LlamaConfig.use_fp8`` — flax ``Fp8DotGeneralOp``) carry an
+    ``_overwrite_with_gradient`` variable collection (delayed-scaling amax
+    histories + scales).  Pass ``params`` as the TWO-collection bundle
+    ``{"params": ..., "_overwrite_with_gradient": ...}`` (and init the
+    optimizer on the ``params`` subtree only): the step threads the
+    collection through apply, keeps it away from the optimizer, and
+    OVERWRITES it with its gradient (the fp8 delayed-scaling update) under
+    a finite guard so skipped overflow steps cannot poison the histories.
     """
     from .parallel.optimizer import BasicOptimizer, DistributedOptimizer
 
     dopt = tx if isinstance(tx, (BasicOptimizer, DistributedOptimizer)) else None
+    OWG = "_overwrite_with_gradient"
 
     def micro_loss(p, micro_batch, step_key, opt_state=None):
         rngs = (
@@ -70,8 +80,13 @@ def make_train_step(
             if step_key is not None
             else None
         )
+        variables = (
+            {"params": p["params"], OWG: p[OWG]}
+            if isinstance(p, dict) and OWG in p
+            else {"params": p}
+        )
         out = dmodel.apply(
-            {"params": p}, micro_batch["input"], deterministic=step_key is None, rngs=rngs
+            variables, micro_batch["input"], deterministic=step_key is None, rngs=rngs
         )
         res = loss_fn(out, micro_batch)
         loss, aux = res if has_aux else (res, None)
@@ -87,6 +102,7 @@ def make_train_step(
         return jnp.sum(a, axis=0)
 
     def step(params, opt_state, batch, step_key=None):
+        fp8_bundle = isinstance(params, dict) and OWG in params
         if grad_accum_steps <= 1:
             if has_aux:
                 (loss, aux), grads = jax.value_and_grad(
@@ -119,32 +135,65 @@ def make_train_step(
                 else:
                     l, g = jax.value_and_grad(lambda p: micro_loss(p, mb, key_i, opt_state))(params)
                     aux_i = None
-                g_acc = jax.tree_util.tree_map(lambda a, b: a + b.astype(a.dtype), g_acc, g)
+                if fp8_bundle:
+                    # OWG "grads" are next-values, not gradients: the last
+                    # micro-batch's delayed-scaling state wins (summing
+                    # amax histories would be meaningless)
+                    g_acc = {
+                        "params": jax.tree_util.tree_map(
+                            lambda a, b: a + b.astype(a.dtype), g_acc["params"], g["params"]
+                        ),
+                        OWG: g[OWG],
+                    }
+                else:
+                    g_acc = jax.tree_util.tree_map(lambda a, b: a + b.astype(a.dtype), g_acc, g)
                 return (g_acc, l_acc + l), aux_i
 
             g0 = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
             (g_sum, l_sum), aux_stack = jax.lax.scan(
                 accum, (g0, 0.0), (micros, jnp.arange(grad_accum_steps))
             )
-            grads = jax.tree_util.tree_map(
-                lambda g, p: (g / grad_accum_steps).astype(p.dtype), g_sum, params
-            )
+            if fp8_bundle:
+                grads = {
+                    "params": jax.tree_util.tree_map(
+                        lambda g, p: (g / grad_accum_steps).astype(p.dtype),
+                        g_sum["params"],
+                        params["params"],
+                    ),
+                    OWG: g_sum[OWG],
+                }
+            else:
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: (g / grad_accum_steps).astype(p.dtype), g_sum, params
+                )
             loss = l_sum / grad_accum_steps
             aux = (
                 jax.tree_util.tree_map(_reduce_aux_leaf, aux_stack) if has_aux else None
             )
+        if fp8_bundle:
+            # the OWG collection never meets the optimizer: its "gradient"
+            # IS its next value (delayed-scaling histories/scales), applied
+            # under a finite guard — an overflow step's inf amax must not
+            # poison the rolling history
+            owg_new = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(jnp.isfinite(new), new, old),
+                grads[OWG],
+                params[OWG],
+            )
+            params_p, grads_p = params["params"], grads["params"]
+        else:
+            params_p, grads_p = params, grads
         if dopt is not None:
-            new_params, new_opt_state = dopt.step(params, opt_state, grads)
+            new_params_p, new_opt_state = dopt.step(params_p, opt_state, grads_p)
             if isinstance(dopt, DistributedOptimizer):
                 # report the UNSCALED loss (pre-step scale — the one
                 # micro_loss multiplied by; the post-step scale differs on
                 # backoff/growth steps)
                 loss = loss / dopt.current_scale(opt_state)
-            if has_aux:
-                return new_params, new_opt_state, loss, aux
-            return new_params, new_opt_state, loss
-        updates, new_opt_state = tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
+        else:
+            updates, new_opt_state = tx.update(grads_p, opt_state, params_p)
+            new_params_p = optax.apply_updates(params_p, updates)
+        new_params = {"params": new_params_p, OWG: owg_new} if fp8_bundle else new_params_p
         if has_aux:
             return new_params, new_opt_state, loss, aux
         return new_params, new_opt_state, loss
