@@ -164,10 +164,15 @@ def rotary(q, k, positions, theta: float):
 
 def _proj_kwargs(c: "LlamaConfig") -> dict:
     """Extra nn.Dense kwargs for the block projections: fp8 routes the
-    matmul through delayed-scaling Fp8DotGeneralOp (embed/lm_head stay
+    matmul through the delayed-scaling fp8 dot op (embed/lm_head stay
     high-precision — standard fp8 recipe keeps the ends of the network
-    out of fp8)."""
-    return {"dot_general_cls": nn.Fp8DotGeneralOp} if c.use_fp8 else {}
+    out of fp8).  Fp8DirectDotGeneralOp is the non-deprecated flax op;
+    the older Fp8DotGeneralOp is the fallback — both keep their state in
+    the _overwrite_with_gradient collection make_train_step understands."""
+    if not c.use_fp8:
+        return {}
+    op = getattr(nn, "Fp8DirectDotGeneralOp", None) or nn.Fp8DotGeneralOp
+    return {"dot_general_cls": op}
 
 
 class LlamaAttention(nn.Module):

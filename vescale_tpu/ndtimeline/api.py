@@ -81,5 +81,17 @@ def ndtimeit(metric: str, tags=None):
 
 
 def ndtimer(metric: str):
-    """Decorator form."""
-    return get_manager().decorator(metric)
+    """Decorator form.  Resolves the manager at CALL time through
+    ``ndtimeit``: dormant runs pay nothing, and an ``init_ndtimers`` after
+    decoration is picked up (a decoration-time manager binding would both
+    defeat the _ACTIVE gate and orphan the spans when the global manager is
+    replaced)."""
+
+    def deco(fn):
+        def wrapped(*args, **kwargs):
+            with ndtimeit(metric):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+    return deco

@@ -73,6 +73,19 @@ class PendingWgrad:
 
 
 class PipeEngine:
+    """Schedule-exact EAGER pipeline executor — the semantics/profiling
+    engine, NOT the hardware perf path.
+
+    Single-controller by construction: activations and cotangents flow
+    through Python tables on the driving process, so it cannot scale
+    multi-host and pays per-instruction dispatch.  On hardware, run real
+    training through the COMPILED pipeline (``pipe/spmd.py``
+    ``pipeline_blocks`` / ``pipeline_blocks_zb`` — one XLA program, ppermute
+    over ICI, multi-host capable).  Use this engine for schedule studies,
+    instruction-level parity tests, and ``profile_costs`` feeding the
+    cost-graph scheduler.  A multi-process run refuses to start (see
+    ``forward_backward``) rather than silently not scaling."""
+
     def __init__(
         self,
         module: PipeModule,
@@ -120,6 +133,14 @@ class PipeEngine:
         (reference engine/pipe.py:138 forward_backward).  In
         ``forward_only`` mode returns (mean_loss_or_None, last_stage_outputs)
         and 'target' may be omitted from the minibatch."""
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "PipeEngine is the single-controller EAGER semantics engine "
+                "(activations flow through Python tables on this process); "
+                "a multi-process run would silently not scale.  Use the "
+                "compiled pipeline — pipe/spmd.py pipeline_blocks / "
+                "pipeline_blocks_zb — for multi-host training."
+            )
         M = num_microbatches or 1
         G = self.module.num_groups
         micro = self._split_microbatches(

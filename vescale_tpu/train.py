@@ -136,14 +136,23 @@ def make_train_step(
                     l, g = jax.value_and_grad(lambda p: micro_loss(p, mb, key_i, opt_state))(params)
                     aux_i = None
                 if fp8_bundle:
-                    # OWG "grads" are next-values, not gradients: the last
-                    # micro-batch's delayed-scaling state wins (summing
-                    # amax histories would be meaningless)
+                    # OWG "grads" are next-values, not gradients.  amax
+                    # histories combine by elementwise MAX across the
+                    # micro-batches (every micro-batch rolled the SAME
+                    # pre-step history, so max captures the true per-step
+                    # amax — a spike in micro-batch 1 must not be dropped
+                    # because micro-batch N was calm); derived scale leaves
+                    # take the latest (identical across micro-batches: all
+                    # computed from the pre-step history).
+                    def owg_one(kp, a, b):
+                        leaf = str(getattr(kp[-1], "key", kp[-1]))
+                        return jnp.maximum(a, b) if "amax_history" in leaf else b
+
                     g_acc = {
                         "params": jax.tree_util.tree_map(
                             lambda a, b: a + b.astype(a.dtype), g_acc["params"], g["params"]
                         ),
-                        OWG: g[OWG],
+                        OWG: jax.tree_util.tree_map_with_path(owg_one, g_acc[OWG], g[OWG]),
                     }
                 else:
                     g_acc = jax.tree_util.tree_map(lambda a, b: a + b.astype(a.dtype), g_acc, g)
