@@ -250,6 +250,22 @@ def test_pipeline_blocks_auto_act_spec_parity():
     np.testing.assert_allclose(np.asarray(sp_out), np.asarray(base_out), rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(sp_g), np.asarray(base_g), rtol=1e-5, atol=1e-5)
 
+    # the zero-bubble path takes the same knob (it pins the xins/dys
+    # stashes, ZB's dominant activation memory)
+    from vescale_tpu.pipe.spmd import pipeline_blocks_zb
+
+    def loss_zb(W, x, **kw):
+        return jnp.sum(
+            pipeline_blocks_zb(block_fn, W, x, mesh, num_microbatches=2, **kw) ** 2
+        )
+
+    zb_g = jax.jit(jax.grad(loss_zb))(W, x)
+    zb_g_sp = jax.jit(
+        jax.grad(lambda W, x: loss_zb(W, x, auto_act_spec=P("dp", "tp")))
+    )(W, x)
+    np.testing.assert_allclose(np.asarray(zb_g_sp), np.asarray(zb_g), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(zb_g), np.asarray(base_g), rtol=1e-4, atol=1e-5)
+
 
 def test_params_split_tail_heavy():
     """regression: PARAMETERS split with weight concentrated in last units."""

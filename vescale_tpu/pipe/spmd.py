@@ -158,6 +158,19 @@ def _prepare(x, mesh, pp_dim, num_microbatches, virtual_chunks, extra_specs, sta
     return S, M, B, xm, act_spec, manual
 
 
+def _constrain_auto(z, auto_act_spec: Optional[P], lead: int = 0):
+    """Pin an activation buffer to ``auto_act_spec`` on the AUTO axes
+    (legal inside the pp-manual shard_map: dp/tp/... stay GSPMD-managed).
+    A bare PartitionSpec resolves against the CONTEXT mesh, whose axis
+    types are (Manual, Auto, ...) here — a NamedSharding built from the
+    concrete mesh would carry all-Auto types and trip the context-mesh
+    check when sharding propagates (zeros_like etc.)."""
+    if auto_act_spec is None:
+        return z
+    spec = P(*((None,) * lead + tuple(auto_act_spec)))
+    return jax.lax.with_sharding_constraint(z, spec)
+
+
 # ------------------------------------------------------------- 1F1B / VPP
 def pipeline_blocks(
     block_fn: Callable,
@@ -196,16 +209,7 @@ def pipeline_blocks(
     T = _vpp_total_steps(S, V, M)
 
     def constrain(z, lead: int = 0):
-        # pin an activation buffer to auto_act_spec on the AUTO axes (legal
-        # inside the pp-manual shard_map: dp/tp/... stay GSPMD-managed).
-        # A bare PartitionSpec resolves against the CONTEXT mesh, whose
-        # axis types are (Manual, Auto, ...) here — a NamedSharding built
-        # from the concrete mesh would carry all-Auto types and trip the
-        # context-mesh check when sharding propagates (zeros_like etc.)
-        if auto_act_spec is None:
-            return z
-        spec = P(*((None,) * lead + tuple(auto_act_spec)))
-        return jax.lax.with_sharding_constraint(z, spec)
+        return _constrain_auto(z, auto_act_spec, lead)
 
     def worker(params, xm_local):
         # leaves (V, ...): the local stage's chunks
@@ -265,9 +269,15 @@ def pipeline_blocks_zb(
     num_microbatches: Optional[int] = None,
     extra_specs: Optional[P] = None,
     virtual_chunks: int = 1,
+    auto_act_spec: Optional[P] = None,
 ):
     """``pipeline_blocks`` with a REAL zero-bubble backward
     (reference zero_bubble_v.py: B/W split).
+
+    ``auto_act_spec`` pins the microbatch stash AND the per-step
+    input/cotangent stashes (``xins``/``dys`` — ZB's dominant activation
+    memory, T steps x microbatch each) to the given auto-axis layout, the
+    same 405B-scale memory knob as ``pipeline_blocks``.
 
     Forward is the same rotating scan (inputs stashed per step).  The custom
     backward runs two phases:
@@ -294,7 +304,7 @@ def pipeline_blocks_zb(
     def worker(params, xm_local):
         perm = [(i, (i + 1) % S) for i in range(S)]
         perm_rev = [(i, (i - 1) % S) for i in range(S)]
-        micro = xm_local
+        micro = _constrain_auto(xm_local, auto_act_spec, lead=1)
 
         @jax.custom_vjp
         def pipe(params, micro):
@@ -306,8 +316,10 @@ def pipeline_blocks_zb(
             # the enclosing worker trace would leak into the custom_vjp
             idx = jax.lax.axis_index(pp_dim)
             outs0 = jnp.zeros_like(micro)
-            act0 = jnp.zeros_like(micro[0])
-            xin0 = jnp.zeros((T, *micro.shape[1:]), micro.dtype)
+            act0 = _constrain_auto(jnp.zeros_like(micro[0]), auto_act_spec)
+            xin0 = _constrain_auto(
+                jnp.zeros((T, *micro.shape[1:]), micro.dtype), auto_act_spec, lead=1
+            )
 
             def body(carry, t):
                 act, outs, xins = carry
@@ -317,7 +329,9 @@ def pipeline_blocks_zb(
                     inject, jax.lax.dynamic_index_in_dim(micro, mc, 0, keepdims=False), act
                 )
                 xins = jax.lax.dynamic_update_index_in_dim(xins, x_in, t, 0)
-                y = block_fn(_index_chunk(params, v, V), x_in)
+                y = _constrain_auto(
+                    block_fn(_index_chunk(params, v, V), x_in), auto_act_spec
+                )
                 outs = jax.lax.dynamic_update_index_in_dim(
                     outs,
                     jnp.where(
@@ -377,9 +391,11 @@ def pipeline_blocks_zb(
                 dact_next = jax.lax.ppermute(dx, pp_dim, perm_rev)
                 return (dact_next, dmicro, dys), None
 
-            dact0 = jnp.zeros_like(micro[0])
+            dact0 = _constrain_auto(jnp.zeros_like(micro[0]), auto_act_spec)
             dmicro0 = jnp.zeros_like(micro)
-            dys0 = jnp.zeros((T, *micro.shape[1:]), micro.dtype)
+            dys0 = _constrain_auto(
+                jnp.zeros((T, *micro.shape[1:]), micro.dtype), auto_act_spec, lead=1
+            )
             (_, dmicro, dys), _ = jax.lax.scan(
                 bwd_body, (dact0, dmicro0, dys0), jnp.arange(T - 1, -1, -1)
             )
