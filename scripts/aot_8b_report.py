@@ -656,8 +656,9 @@ def main():
                           "zero-bubble point assumes the ZB schedule "
                           "(pipe/spmd.py pipeline_blocks_zb, dgrad/wgrad "
                           "split) fills it with deferred W-passes — "
-                          "compiled for real at the 8B rung "
-                          "(VESCALE_AOT_ZB=1 -> AOT_8B_ZB_REPORT.json)",
+                          "compiled for real at EVERY rung "
+                          "(VESCALE_AOT_ZB=1 -> AOT_*_ZB_REPORT.json; all "
+                          "four fit HBM on the ZB stash layout too)",
             },
             "step_seconds_justified_1f1b": round(step_point_1f1b, 4),
             "step_seconds_justified_zero_bubble": round(step_point_zb, 4),
