@@ -39,6 +39,29 @@ def peak_flops_per_chip(device) -> float:
     return 1e12  # CPU fallback so the line still prints
 
 
+def _step_report_line(step, params, opt_state, batch, on_tpu):
+    """Compile-time step report (telemetry/step_report.py) trimmed for the
+    bench line: XLA FLOPs / peak HBM / collective counts of the exact step
+    program.  AOT lower+compile is a SECOND compile of the step, so on TPU
+    it is opt-in (VESCALE_BENCH_STEP_REPORT=1); on CPU smoke it is cheap and
+    on by default.  Never fails the bench — errors degrade to None."""
+    if os.environ.get("VESCALE_BENCH_STEP_REPORT", "0" if on_tpu else "1") != "1":
+        return None
+    try:
+        from vescale_tpu.telemetry.step_report import build_step_report
+
+        r = build_step_report(step, params, opt_state, batch, name="bench_step")
+        return {
+            "flops": r.get("flops"),
+            "peak_bytes": r.get("peak_bytes"),
+            "temp_bytes": r.get("temp_bytes"),
+            "collectives": {k: v for k, v in (r.get("collectives") or {}).items() if v},
+        }
+    except Exception as e:
+        print(f"[bench] step report failed (non-fatal): {e!r}", file=sys.stderr)
+        return None
+
+
 def time_and_report(step, params, opt_state, batch, *, n, tokens_per_step,
                     flops_per_token, metric, on_tpu, extra=None):
     """Warmup + timed loop + one JSON line (shared by every bench rung).
@@ -46,6 +69,7 @@ def time_and_report(step, params, opt_state, batch, *, n, tokens_per_step,
     the loss is host-fetched for true timings."""
     import jax
 
+    step_report = _step_report_line(step, params, opt_state, batch, on_tpu)
     for _ in range(3):
         params, opt_state, loss = step(params, opt_state, batch)
         float(loss)
@@ -64,6 +88,8 @@ def time_and_report(step, params, opt_state, batch, *, n, tokens_per_step,
         "tokens_per_sec_per_chip": round(tokens_per_step / dt / n, 1),
         "step_time_ms": round(dt * 1e3, 2),
     }
+    if step_report is not None:
+        line["step_report"] = step_report
     line.update(extra or {})
     print(json.dumps(line))
     return mfu

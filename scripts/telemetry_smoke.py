@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Telemetry smoke test — the acceptance contract of docs/observability.md.
+
+Runs a tiny CPU train loop with ``telemetry.init()`` on and validates every
+output surface end to end:
+
+  1. ``steps.jsonl``: one JSON object per step carrying step_time_s, loss,
+     tokens_per_sec and grad_norm (plus loss-scale value / skip count from
+     the DistributedOptimizer).
+  2. The compile-time step report: FLOPs / peak-memory / collective counts,
+     with the collective counts AGREEING with ``debug.comm_mode.comm_counts``
+     on the same program.
+  3. The Prometheus text dump: accepted by the strict line-format parser.
+  4. The gating contract: a second loop WITHOUT ``init()`` emits nothing.
+
+Exit 0 on success, 1 with a FAIL line per broken check.  Wired into tier-1
+via tests/test_telemetry.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def check(failures, ok: bool, label: str) -> None:
+    print(("PASS" if ok else "FAIL") + f"  {label}")
+    if not ok:
+        failures.append(label)
+
+
+def build_step(telemetry_on: bool):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from vescale_tpu.dmodule import parallelize_module
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.models.llama import Llama, LlamaConfig, llama_plan
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
+    from vescale_tpu.parallel.optimizer import DistributedOptimizer
+    from vescale_tpu.train import make_train_step
+
+    B, T = 2, 32
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=T, dtype=jnp.float32,
+    )
+    mesh = DeviceMesh(("dp", "tp"), (1, 1), devices=jax.devices()[:1])
+    dm = parallelize_module(Llama(cfg), mesh, llama_plan(mesh, sequence_parallel=False))
+    params = dm.init(jax.random.key(0), jnp.ones((2, T), jnp.int32))["params"]
+    # dynamic loss scaling: exercises the loss-scale / skip-count telemetry
+    dopt = DistributedOptimizer(optax.adamw(1e-3), loss_scale="dynamic", init_scale=2.0)
+    opt_state = dopt.init(params)
+    step = make_train_step(
+        dm, dopt, lambda lg, b: cross_entropy_loss(lg, b["target"]),
+        donate=False, with_metrics=telemetry_on or None,
+    )
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T + 1)), jnp.int32)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+    return step, params, opt_state, batch
+
+
+def main() -> int:
+    failures: list = []
+    from vescale_tpu import telemetry
+    from vescale_tpu.debug.comm_mode import comm_counts
+    from vescale_tpu.telemetry.exporters import parse_prometheus_text
+
+    out_dir = tempfile.mkdtemp(prefix="telemetry_smoke_")
+
+    # ------------------------------------------------- instrumented loop
+    telemetry.init(out_dir=out_dir)
+    step, params, opt_state, batch = build_step(telemetry_on=True)
+    n_steps = 4
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    report = telemetry.write_step_report("train_step", step, params, opt_state, batch)
+    prom = telemetry.prometheus_dump()
+    dash = telemetry.dashboard()
+    telemetry.shutdown()
+
+    # (a) per-step JSONL
+    jsonl_path = os.path.join(out_dir, "steps.jsonl")
+    check(failures, os.path.exists(jsonl_path), "steps.jsonl exists")
+    records = []
+    with open(jsonl_path) as f:
+        for line in f:
+            records.append(json.loads(line))
+    check(failures, len(records) == n_steps, f"steps.jsonl has {n_steps} records")
+    required = ("step_time_s", "tokens_per_sec", "loss", "grad_norm",
+                "loss_scale", "skip_count")
+    for key in required:
+        check(failures, all(key in r for r in records), f"every record has {key!r}")
+    check(failures, all(r["step_time_s"] > 0 for r in records), "step times positive")
+
+    # (b) compile-time step report
+    report_path = os.path.join(out_dir, "train_step_report.json")
+    check(failures, os.path.exists(report_path), "step report written")
+    on_disk = json.load(open(report_path))
+    for key in ("flops", "peak_bytes", "collectives"):
+        check(failures, key in on_disk, f"step report has {key!r}")
+    check(failures, (on_disk.get("flops") or 0) > 0, "step report FLOPs > 0")
+    # the report's collective counts must agree with comm_counts on the
+    # SAME program (shared counter over the same optimized HLO)
+    direct = comm_counts(step._jitted, params, opt_state, batch)
+    check(failures, report["collectives"] == direct,
+          "report collectives == comm_counts on the same program")
+
+    # (c) prometheus text exposition
+    check(failures, prom is not None, "prometheus_dump returned text")
+    series = parse_prometheus_text(prom or "")
+    check(failures, series.get("train_steps_total") == float(n_steps),
+          "prometheus train_steps_total matches")
+    check(failures, 'train_step_time_seconds{quantile="0.5"}' in series,
+          "prometheus has step-time p50 summary series")
+    check(failures, os.path.exists(os.path.join(out_dir, "metrics.prom")),
+          "metrics.prom written")
+    check(failures, bool(dash and "train_steps_total" in dash),
+          "dashboard renders the registry")
+
+    # ---------------------------------------------- dormant (gated) loop
+    before = set(os.listdir(out_dir))
+    step2, p2, s2, b2 = build_step(telemetry_on=False)
+    for _ in range(2):
+        p2, s2, loss2 = step2(p2, s2, b2)
+    check(failures, not telemetry.is_active(), "gate: telemetry dormant after shutdown")
+    check(failures, telemetry.get_registry() is None, "gate: no registry allocated")
+    check(failures, telemetry.record_step({"loss": 1.0}) is None, "gate: record_step no-op")
+    check(failures, set(os.listdir(out_dir)) == before, "gate: dormant run wrote no files")
+
+    if failures:
+        print(f"\ntelemetry smoke: {len(failures)} FAILED")
+        return 1
+    print(f"\ntelemetry smoke: all checks passed (artifacts in {out_dir})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

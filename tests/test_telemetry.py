@@ -1,0 +1,371 @@
+"""Unified runtime telemetry (vescale_tpu/telemetry/): registry, exporters,
+step reports, straggler detection, the zero-overhead gate — plus the
+ChromeTraceHandler JSON contract and the ndtimeline satellite fixes
+(flush step_range, ndtimer functools.wraps)."""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vescale_tpu import telemetry
+from vescale_tpu.telemetry import api as tel_api
+from vescale_tpu.telemetry.exporters import parse_prometheus_text, prometheus_text
+from vescale_tpu.telemetry.registry import MetricsRegistry
+from vescale_tpu.telemetry.straggler import StragglerDetector
+from vescale_tpu.ndtimeline.timer import NDTimerManager, Span
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    yield
+    telemetry.shutdown()
+
+
+# ------------------------------------------------------------------- gate
+def test_gate_dormant_is_noop_and_allocation_free(tmp_path):
+    assert not telemetry.is_active()
+    assert tel_api._STATE is None
+    # every hot helper no-ops without allocating any state
+    assert telemetry.record_step({"loss": 1.0, "step_time_s": 0.1}) is None
+    assert telemetry.observe("x", 1.0) is None
+    assert telemetry.count("y") is None
+    assert telemetry.set_gauge("z", 2.0) is None
+    assert telemetry.prometheus_dump() is None
+    assert telemetry.dashboard() is None
+    assert telemetry.write_step_report("s", lambda x: x, 1.0) is None
+    assert telemetry.get_registry() is None
+    assert tel_api._STATE is None  # still nothing allocated
+    assert list(tmp_path.iterdir()) == []  # and nothing written anywhere
+
+
+def test_gate_init_shutdown_cycle(tmp_path):
+    st = telemetry.init(out_dir=str(tmp_path / "run"))
+    assert telemetry.is_active() and telemetry.get_state() is st
+    telemetry.count("c", 2)
+    assert telemetry.get_registry().counter("c").value == 2
+    telemetry.shutdown()
+    assert not telemetry.is_active()
+    assert telemetry.get_registry() is None
+
+
+# --------------------------------------------------------------- registry
+def test_registry_metrics_and_percentiles():
+    reg = MetricsRegistry(default_window=16)
+    reg.counter("n").inc(3)
+    reg.gauge("g").set(1.5)
+    h = reg.histogram("h")
+    for v in range(1, 101):
+        h.observe(float(v))  # window 16 keeps 85..100
+    assert h.count == 100 and h.sum == 5050.0
+    snap = reg.snapshot()
+    assert snap["counters"]["n"] == 3
+    assert snap["gauges"]["g"] == 1.5
+    hs = snap["histograms"]["h"]
+    assert hs["window"] == 16 and hs["min"] == 85.0 and hs["max"] == 100.0
+    assert hs["p50"] == 92.0  # nearest-rank over the rolling window
+    with pytest.raises(ValueError):
+        reg.counter("n").inc(-1)
+    with pytest.raises(TypeError):
+        reg.gauge("n")  # name already bound to a Counter
+
+
+def test_rolling_window_ages_out_warmup_outlier():
+    reg = MetricsRegistry(default_window=8)
+    h = reg.histogram("t")
+    h.observe(100.0)  # warmup outlier
+    for _ in range(8):
+        h.observe(1.0)
+    assert h.percentile(0.99) == 1.0  # outlier aged out of the window
+    assert h.sum == 108.0             # totals stay exact
+
+
+# -------------------------------------------------------------- exporters
+def test_prometheus_text_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("steps_total").inc(5)
+    reg.gauge("loss").set(2.25)
+    for v in (0.1, 0.2, 0.3):
+        reg.histogram("step_time").observe(v)
+    text = prometheus_text(reg)
+    series = parse_prometheus_text(text)  # raises on any malformed line
+    assert series["steps_total"] == 5.0
+    assert series["loss"] == 2.25
+    assert series['step_time{quantile="0.5"}'] == 0.2
+    assert series["step_time_count"] == 3.0
+    assert math.isclose(series["step_time_sum"], 0.6)
+    with pytest.raises(ValueError):
+        parse_prometheus_text("not a metric line at all {{{")
+
+
+def test_jsonl_stream_and_dashboard(tmp_path):
+    out = str(tmp_path / "run")
+    telemetry.init(out_dir=out)
+    for i in range(3):
+        telemetry.record_step(
+            {"step": i, "step_time_s": 0.01 * (i + 1), "loss": 3.0 - i, "tokens": 64}
+        )
+    dash = telemetry.dashboard()
+    reg = telemetry.get_registry()
+    # registry aggregation happened alongside the stream
+    assert reg.counter("train_steps_total").value == 3
+    assert reg.counter("train_tokens_total").value == 192
+    assert reg.gauge("train_loss").value == 1.0  # last value
+    assert reg.histogram("train_step_time_seconds").count == 3
+    telemetry.shutdown()
+    lines = [json.loads(l) for l in open(os.path.join(out, "steps.jsonl"))]
+    assert [r["step"] for r in lines] == [0, 1, 2]
+    assert all("ts" in r and "rank" in r for r in lines)
+    assert "train_steps_total" in dash and "train_step_time_seconds" in dash
+
+
+def test_prometheus_dump_writes_file(tmp_path):
+    telemetry.init(out_dir=str(tmp_path))
+    telemetry.count("events_total", 7)
+    text = telemetry.prometheus_dump()
+    telemetry.shutdown()
+    on_disk = open(tmp_path / "metrics.prom").read()
+    assert on_disk == text
+    assert parse_prometheus_text(on_disk)["events_total"] == 7.0
+
+
+# ------------------------------------------------------------ step report
+def test_step_report_matches_comm_counts(tmp_path):
+    from vescale_tpu.debug.comm_mode import comm_counts
+
+    def fn(x):
+        return jnp.sin(x) @ x.T
+
+    x = jnp.ones((16, 16), jnp.float32)
+    telemetry.init(out_dir=str(tmp_path))
+    report = telemetry.write_step_report("prog", fn, x)
+    telemetry.shutdown()
+    assert report["flops"] is not None and report["flops"] > 0
+    assert report["collectives"] == comm_counts(fn, x)
+    on_disk = json.load(open(tmp_path / "prog_report.json"))
+    assert on_disk["name"] == "prog"
+    for key in ("flops", "peak_bytes", "argument_bytes", "output_bytes",
+                "temp_bytes", "collectives", "num_devices", "platform"):
+        assert key in on_disk
+    # the registry mirrors the headline numbers as gauges
+    # (checked via a fresh dump in test_prometheus_dump_writes_file shape)
+
+
+def test_step_report_counts_collectives_on_sharded_program(mesh1d):
+    from vescale_tpu.telemetry.step_report import build_step_report
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh1d.jax_mesh, PartitionSpec("tp", None))
+
+    def fn(a, b):
+        return a @ b  # contraction over a tp-sharded dim -> all-reduce/scatter
+
+    a = jax.device_put(jnp.ones((8, 32)), NamedSharding(mesh1d.jax_mesh, PartitionSpec(None, "tp")))
+    b = jax.device_put(jnp.ones((32, 8)), sharding)
+    report = build_step_report(fn, a, b, name="sharded")
+    assert sum(report["collectives"].values()) >= 1
+
+
+# -------------------------------------------------------------- straggler
+def _spans(metric, rank, durations_ms, step=0):
+    return [
+        Span(metric=metric, start=0.0, duration=d / 1e3, step=step, rank=rank)
+        for d in durations_ms
+    ]
+
+
+def test_straggler_detector_flags_slow_rank():
+    det = StragglerDetector(threshold=1.5, min_ranks=3)
+    for r in (0, 1, 2):
+        det(_spans("forward", r, [10.0] * 5))
+    det(_spans("forward", 3, [40.0] * 5))
+    report = det.report()
+    assert [e["rank"] for e in report] == [3]
+    assert report[0]["metric"] == "forward" and report[0]["ratio"] > 3.0
+    assert not det.healthy()
+    assert "rank 3" in det.summary()
+
+
+def test_straggler_detector_below_min_ranks_is_silent():
+    det = StragglerDetector(min_ranks=3)
+    det(_spans("fwd", 0, [1.0]))
+    det(_spans("fwd", 1, [100.0]))  # only 2 ranks: no population
+    assert det.report() == [] and det.healthy()
+    with pytest.raises(ValueError):
+        StragglerDetector(threshold=1.0)
+
+
+def test_straggler_from_merged_rollup():
+    det = StragglerDetector(threshold=1.5, min_ranks=2)
+    merged = {
+        (0, "allreduce"): {"per_rank_ms": {0: 5.0, 1: 5.0, 2: 5.0, 3: 20.0}},
+        (1, "allreduce"): {"per_rank_ms": {0: 5.0, 1: 5.0, 2: 5.0, 3: 22.0}},
+    }
+    det.update_from_merged(merged)
+    assert det.spans_seen == 8
+    assert [e["rank"] for e in det.report()] == [3]
+
+
+def test_streamer_attaches_straggler_detector(tmp_path):
+    from vescale_tpu.ndtimeline.streamer import NDtimelineStreamer
+
+    addr = str(tmp_path / "s.sock")
+    streamer = NDtimelineStreamer.start(addr, straggler=2.0)
+    try:
+        assert isinstance(streamer.straggler, StragglerDetector)
+        assert streamer.straggler.threshold == 2.0
+        assert streamer.straggler in streamer.handlers
+        # merged cross-rank stream -> detector (direct feed; the socket wire
+        # path is covered by test_ndtimeline_streamer.py)
+        for r in (0, 1):
+            streamer.straggler(_spans("fwd", r, [1.0] * 4))
+        streamer.straggler(_spans("fwd", 2, [50.0] * 4))
+        assert [e["rank"] for e in streamer.straggler.report()] == [2]
+    finally:
+        streamer.stop()
+
+
+# ----------------------------------------------------- chrome trace (sat)
+def test_chrome_trace_handler_emits_loadable_trace(tmp_path):
+    from vescale_tpu.ndtimeline.handlers import ChromeTraceHandler
+
+    path = str(tmp_path / "trace.json")
+    h = ChromeTraceHandler(path)
+    t0 = time.time()
+    h(
+        [
+            Span("forward", t0, 0.010, step=0, rank=0, tags={"mb": 0}),
+            Span("backward", t0 + 0.011, 0.020, step=0, rank=0),
+            Span("forward", t0 + 0.032, 0.010, step=1, rank=1),
+        ]
+    )
+    out = h.write()
+    doc = json.load(open(out))  # loadable JSON
+    events = doc["traceEvents"]
+    assert len(events) == 3
+    for ev in events:
+        assert ev["ph"] == "X"  # complete events, the chrome://tracing core
+        for key in ("name", "ts", "dur", "pid", "tid", "args"):
+            assert key in ev
+        assert ev["ts"] >= 0 and ev["dur"] > 0
+    # spans recorded in order emit monotonically non-decreasing timestamps
+    ts = [ev["ts"] for ev in events]
+    assert ts == sorted(ts)
+    assert {ev["pid"] for ev in events} == {0, 1}  # rank -> pid lanes
+
+
+# ------------------------------------------------- ndtimeline satellites
+def test_ndtimer_preserves_function_identity():
+    from vescale_tpu.ndtimeline.api import ndtimer
+
+    @ndtimer("train-step")
+    def my_step(x):
+        """docstring survives."""
+        return x + 1
+
+    assert my_step.__name__ == "my_step"
+    assert my_step.__doc__ == "docstring survives."
+    assert my_step(1) == 2
+
+
+def test_flush_honors_step_range():
+    mgr = NDTimerManager(rank=0)
+    got = []
+    mgr.register_handler(got.extend)
+    for step in range(3):
+        mgr.step = step
+        mgr.record(f"m{step}", start=float(step), duration=0.001)
+    flushed = mgr.flush(step_range=(1, 2))
+    assert [s.metric for s in flushed] == ["m1"]
+    assert [s.metric for s in got] == ["m1"]  # handlers saw only the window
+    rest = mgr.flush()  # out-of-window spans stayed buffered
+    assert sorted(s.metric for s in rest) == ["m0", "m2"]
+
+
+def test_api_flush_step_range_and_next_iteration():
+    from vescale_tpu.ndtimeline import api as nd_api
+
+    old_mgr, old_active = nd_api._MANAGER, nd_api._ACTIVE
+    try:
+        mgr = nd_api.init_ndtimers(rank=0)
+        with mgr.timeit("a"):
+            pass
+        mgr.inc_step()
+        with mgr.timeit("b"):
+            pass
+        spans = nd_api.flush(step_range=range(0, 1), next_iteration=True)
+        assert [s.metric for s in spans] == ["a"]
+        assert mgr.step == 2  # next_iteration advanced the counter
+        assert [s.metric for s in nd_api.flush()] == ["b"]
+        with pytest.raises(ValueError):
+            nd_api.flush(step_range=(3, 1))
+    finally:
+        nd_api._MANAGER, nd_api._ACTIVE = old_mgr, old_active
+
+
+# ------------------------------------------------------ runtime feeds
+def test_checkpoint_feeds_registry(tmp_path):
+    import vescale_tpu.checkpoint as ckpt
+
+    telemetry.init(out_dir=None)  # in-memory registry only
+    state = {"model": {"w": np.arange(64, dtype=np.float32).reshape(8, 8)}}
+    ckpt.save(str(tmp_path / "ck"), state)
+    ckpt.load(str(tmp_path / "ck"), state)
+    reg = telemetry.get_registry()
+    assert reg.counter("checkpoint_saves_total").value == 1
+    assert reg.counter("checkpoint_loads_total").value == 1
+    assert reg.counter("checkpoint_bytes_written_total").value == 64 * 4
+    assert reg.counter("checkpoint_bytes_read_total").value >= 64 * 4
+    assert reg.histogram("checkpoint_save_seconds").count == 1
+    assert reg.histogram("checkpoint_load_seconds").count == 1
+    assert reg.histogram("checkpoint_commit_seconds").count == 1
+    telemetry.shutdown()
+    # dormant: another save must not grow anything (no registry exists)
+    ckpt.save(str(tmp_path / "ck2"), state)
+    assert telemetry.get_registry() is None
+
+
+def test_pipe_engine_feeds_registry():
+    from vescale_tpu.models.nanogpt import GPTConfig, cross_entropy_loss, gpt_pipeline_units
+    from vescale_tpu.pipe import PipeEngine, construct_pipeline_stage
+    from vescale_tpu.plan import PipelineParallelPlan
+
+    cfg = GPTConfig(block_size=8, vocab_size=32, n_layer=2, n_head=2, n_embd=16, dropout=0.0)
+    plan = PipelineParallelPlan(num_stages=2)
+    pm = construct_pipeline_stage(gpt_pipeline_units(cfg), plan)
+    params = pm.init_all(jax.random.key(0), jnp.ones((2, cfg.block_size), jnp.int32))
+    engine = PipeEngine(pm, plan, cross_entropy_loss)
+    toks = jax.random.randint(jax.random.key(1), (4, cfg.block_size + 1), 0, cfg.vocab_size)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+    telemetry.init(out_dir=None)
+    engine.forward_backward(params, batch, num_microbatches=2)
+    reg = telemetry.get_registry()
+    assert reg.counter("pipe_forward_backward_total").value == 1
+    M = 2
+    # 2 stages x (1 fwd + 1 bwd) x 2 microbatches
+    assert reg.counter("pipe_instructions_total").value == 2 * 2 * M
+    assert reg.gauge("pipe_num_microbatches").value == M
+    assert reg.histogram("pipe_forward_backward_seconds").count == 1
+
+
+# ------------------------------------------------------------- smoke (CI)
+def test_telemetry_smoke_script():
+    """tier-1 wiring of scripts/telemetry_smoke.py (the acceptance run)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "telemetry_smoke.py")],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=root,
+    )
+    assert proc.returncode == 0, f"smoke failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "all checks passed" in proc.stdout

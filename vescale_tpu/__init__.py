@@ -48,6 +48,7 @@ from . import collectives
 #   vescale_tpu.moe         (expert parallel)
 #   vescale_tpu.checkpoint  (distributed save/load + reshard)
 #   vescale_tpu.ndtimeline  (profiler)
+#   vescale_tpu.telemetry   (metrics registry / step reports / exporters)
 #   vescale_tpu.emulator    (bitwise collective replay)
 #   vescale_tpu.debug       (CommDebugMode / DebugLogger)
 #   vescale_tpu.dmp         (auto-plan)

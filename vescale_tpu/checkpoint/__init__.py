@@ -25,6 +25,7 @@ memory store in the single-controller model).
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -185,11 +186,19 @@ def save(
     ``on_commit``: called (on whatever thread runs the commit) right after
     meta.json lands — fire-and-forget async callers get an exact
     commit-time hook (CheckpointManager rotation) without polling."""
+    from .. import telemetry as _tel
     from ..ndtimeline.api import ndtimeit
     from ..ndtimeline.predefined import CHECKPOINT_SAVE
 
+    t0 = time.perf_counter()
     with ndtimeit(CHECKPOINT_SAVE, tags={"path": path, "async": async_checkpoint}):
-        return _save_impl(path, checkpoint_state, async_checkpoint, num_io_workers, on_commit)
+        out = _save_impl(path, checkpoint_state, async_checkpoint, num_io_workers, on_commit)
+    if _tel.is_active():
+        # NOTE async saves: this is submit latency (the io workers keep
+        # writing); commit latency lands separately on checkpoint_commit
+        _tel.count("checkpoint_saves_total")
+        _tel.observe("checkpoint_save_seconds", time.perf_counter() - t0)
+    return out
 
 
 def _save_impl(
@@ -199,9 +208,12 @@ def _save_impl(
     num_io_workers: int,
     on_commit,
 ) -> Optional[CheckpointHandle]:
+    from .. import telemetry as _tel
+
     storage = _storage_for(path)
     writer = AsyncWriter(storage, num_io_workers)
     meta: Dict[str, Any] = {"arrays": {}}
+    bytes_submitted = 0  # this process's share of the data chunks
     me = jax.process_index()
     nproc = jax.process_count()
     proc_of = {d.id: d.process_index for d in jax.devices()} if nproc > 1 else {}
@@ -229,8 +241,12 @@ def _save_impl(
                 fname = f"data/{full_key}/{i}.npy"
                 entry["chunks"].append({**box.to_json(), "file": fname})
                 if _writer_process(leaf, owner, i, nproc, proc_of) == me:
-                    writer.submit(fname, fetch_chunk(leaf, box, owner))
+                    data = fetch_chunk(leaf, box, owner)
+                    bytes_submitted += data.nbytes
+                    writer.submit(fname, data)
             meta["arrays"][full_key] = entry
+    if _tel.is_active():
+        _tel.count("checkpoint_bytes_written_total", bytes_submitted)
 
     # meta.json is the commit marker: it must hit storage only after every
     # data chunk (on every process) is durable.  The commit runs on the
@@ -240,8 +256,12 @@ def _save_impl(
         from ..ndtimeline.api import ndtimeit
         from ..ndtimeline.predefined import CHECKPOINT_COMMIT
 
+        t0 = time.perf_counter()
         with ndtimeit(CHECKPOINT_COMMIT, tags={"path": path}):
             _commit_impl(ok)
+        if _tel.is_active():
+            _tel.count("checkpoint_commits_total")
+            _tel.observe("checkpoint_commit_seconds", time.perf_counter() - t0)
 
     def _commit_impl(ok: bool):
         if nproc > 1:
@@ -417,11 +437,18 @@ def load(
     Scale contract: for DArray / sharded jax.Array targets, each process
     reads only the saved chunks intersecting its ADDRESSABLE shards and
     never materializes the full logical array (see ``LAST_LOAD_STATS``)."""
+    from .. import telemetry as _tel
     from ..ndtimeline.api import ndtimeit
     from ..ndtimeline.predefined import CHECKPOINT_LOAD
 
+    t0 = time.perf_counter()
     with ndtimeit(CHECKPOINT_LOAD, tags={"path": path}):
-        return _load_impl(path, checkpoint_state, strict)
+        out = _load_impl(path, checkpoint_state, strict)
+    if _tel.is_active():
+        _tel.count("checkpoint_loads_total")
+        _tel.count("checkpoint_bytes_read_total", LAST_LOAD_STATS["bytes_read"])
+        _tel.observe("checkpoint_load_seconds", time.perf_counter() - t0)
+    return out
 
 
 def _load_impl(path: str, checkpoint_state: Dict[str, Any], strict: bool) -> Dict[str, Any]:

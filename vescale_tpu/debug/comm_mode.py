@@ -16,7 +16,7 @@ from typing import Any, Callable, Dict
 
 import jax
 
-__all__ = ["comm_counts", "CommDebugMode"]
+__all__ = ["comm_counts", "count_collectives", "CommDebugMode"]
 
 # HLO/stableHLO opcodes per logical collective.  Async collectives appear
 # as op-start/op-done pairs — only the start (or sync form) is counted, so
@@ -37,14 +37,10 @@ _COLLECTIVE_OPCODES = {
 _OPCODE_RE = re.compile(r"(?<![%\w.])([a-z][a-z0-9\-\._]*)\(")
 
 
-def comm_counts(fn: Callable, *args, static_argnums=(), **kwargs) -> Dict[str, int]:
-    """Compile ``fn(*args, **kwargs)`` and count collectives in the
-    optimized HLO (after GSPMD partitioning)."""
-    lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args, **kwargs)
-    try:
-        text = lowered.compile().as_text()
-    except Exception:
-        text = lowered.as_text()
+def count_collectives(text: str) -> Dict[str, int]:
+    """Count collective ops in (stable)HLO text — the shared counter behind
+    ``comm_counts`` and the telemetry step reports, so the two views agree
+    by construction on the same program."""
     out = {name: 0 for name in _COLLECTIVE_OPCODES}
     for line in text.splitlines():
         line = line.strip()
@@ -61,6 +57,17 @@ def comm_counts(fn: Callable, *args, static_argnums=(), **kwargs) -> Dict[str, i
                 break  # one collective application per instruction line
     out["total"] = sum(v for k, v in out.items() if k != "total")
     return out
+
+
+def comm_counts(fn: Callable, *args, static_argnums=(), **kwargs) -> Dict[str, int]:
+    """Compile ``fn(*args, **kwargs)`` and count collectives in the
+    optimized HLO (after GSPMD partitioning)."""
+    lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args, **kwargs)
+    try:
+        text = lowered.compile().as_text()
+    except Exception:
+        text = lowered.as_text()
+    return count_collectives(text)
 
 
 class CommDebugMode:
@@ -81,8 +88,21 @@ class CommDebugMode:
         return False
 
     def trace(self, fn: Callable, *args, **kwargs):
-        self.counts = comm_counts(fn, *args, **kwargs)
-        return jax.jit(fn)(*args, **kwargs)
+        """Count collectives AND execute — compiling ONCE: the lowered
+        program is compiled to an executable that serves both the optimized
+        HLO text (counting) and the actual run (previously this compiled
+        twice: ``comm_counts``' throwaway ``lowered.compile()`` plus a fresh
+        ``jax.jit(fn)(*args)``)."""
+        lowered = jax.jit(fn).lower(*args, **kwargs)
+        try:
+            compiled = lowered.compile()
+        except Exception:
+            # unpartitionable on this backend: count from the unoptimized
+            # text and fall back to the normal jit path for execution
+            self.counts = count_collectives(lowered.as_text())
+            return jax.jit(fn)(*args, **kwargs)
+        self.counts = count_collectives(compiled.as_text())
+        return compiled(*args, **kwargs)
 
     def get_comm_counts(self) -> Dict[str, int]:
         return dict(self.counts)

@@ -94,6 +94,19 @@ def _match(plan: Dict[str, Any], fqn: str) -> Tuple[Optional[str], Any]:
     return None, None
 
 
+def _abstract_mesh_ctx():
+    """The current abstract-mesh context, or None when there is none.
+
+    jax < 0.5 has no public ``jax.sharding.get_abstract_mesh`` (nor
+    ``AxisType``); there no abstract-mesh context can be entered, so the
+    concrete NamedSharding path below is always the right one."""
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is None:
+        return None
+    ctx = get()
+    return ctx if getattr(ctx, "shape_tuple", None) else None
+
+
 def _constrain(x, placements, mesh: DeviceMesh):
     if placements is None or not isinstance(x, (jax.Array, jnp.ndarray)) or np.isscalar(x):
         return x
@@ -103,8 +116,8 @@ def _constrain(x, placements, mesh: DeviceMesh):
     # concrete NamedSharding would not match the context mesh — constrain
     # with the bare PartitionSpec so jax resolves it against the context,
     # dropping axes that are manual there (they're already local).
-    ctx = jax.sharding.get_abstract_mesh()
-    if ctx is not None and ctx.shape_tuple:  # non-empty context mesh
+    ctx = _abstract_mesh_ctx()
+    if ctx is not None:  # non-empty context mesh
         manual = {
             n
             for n, t in zip(ctx.axis_names, ctx.axis_types)

@@ -4,6 +4,7 @@ init_ndtimers, :318 flush, :293 wait, :309 inc_step)."""
 from __future__ import annotations
 
 import contextlib
+import functools
 from typing import Optional
 
 from .timer import NDTimerManager
@@ -46,8 +47,28 @@ def init_ndtimers(rank: int = 0, mesh=None, handlers=(), max_spans: int = 100_00
 
 
 def flush(step_range=None, next_iteration: bool = False):
-    """(api.py:318)"""
-    return get_manager().flush()
+    """(api.py:318) Drain buffered spans to the registered handlers.
+
+    ``step_range``: a ``range`` or ``(lo, hi)`` pair — only spans with
+    ``lo <= span.step < hi`` are flushed (handlers see them, they are
+    returned); spans OUTSIDE the window stay buffered for a later flush.
+    ``next_iteration=True`` advances the global step counter after the
+    flush (the reference's end-of-iteration flush shape)."""
+    if step_range is not None:
+        if isinstance(step_range, range):
+            if step_range.step != 1:
+                raise ValueError(
+                    f"flush: strided step_range unsupported ({step_range})"
+                )
+            step_range = (step_range.start, step_range.stop)
+        lo, hi = step_range
+        if hi < lo:
+            raise ValueError(f"flush: empty/inverted step_range ({lo}, {hi})")
+    mgr = get_manager()
+    spans = mgr.flush(step_range=step_range)
+    if next_iteration:
+        mgr.inc_step()
+    return spans
 
 
 def wait() -> None:
@@ -88,6 +109,8 @@ def ndtimer(metric: str):
     replaced)."""
 
     def deco(fn):
+        @functools.wraps(fn)  # keep __name__/__doc__ for introspection
+        # (jit cache keys in debug dumps, functools caches, help())
         def wrapped(*args, **kwargs):
             with ndtimeit(metric):
                 return fn(*args, **kwargs)

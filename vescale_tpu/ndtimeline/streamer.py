@@ -86,10 +86,30 @@ class NDtimelineStreamer:
         self.received = 0       # spans seen (observability / tests)
         self.decode_errors = 0  # malformed frames -> dropped connections
         self.handler_errors = 0
+        self.straggler = None   # set by start(straggler=...)
 
     @classmethod
-    def start(cls, addr: Addr, handlers: Sequence[Callable[[List[Span]], None]] = ()) -> "NDtimelineStreamer":
+    def start(
+        cls,
+        addr: Addr,
+        handlers: Sequence[Callable[[List[Span]], None]] = (),
+        straggler=None,
+    ) -> "NDtimelineStreamer":
+        """``straggler``: attach a cross-rank straggler detector
+        (telemetry/straggler.py) as a handler over the merged span stream —
+        pass ``True`` for defaults, a float for a threshold multiple, or a
+        preconfigured ``StragglerDetector``.  Query it via
+        ``streamer.straggler.report()`` / ``.summary()``."""
         st = cls(addr, handlers)
+        if straggler is not None and straggler is not False:
+            from ..telemetry.straggler import StragglerDetector
+
+            if straggler is True:
+                straggler = StragglerDetector()
+            elif isinstance(straggler, (int, float)):
+                straggler = StragglerDetector(threshold=float(straggler))
+            st.straggler = straggler
+            st.handlers.append(straggler)
         t = threading.Thread(target=st._accept_loop, daemon=True, name="ndtimeline-accept")
         t.start()
         st._threads.append(t)

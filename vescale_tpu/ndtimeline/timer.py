@@ -97,10 +97,20 @@ class NDTimerManager:
         self.step += n
 
     # ----------------------------------------------------------- flush
-    def flush(self) -> List[Span]:
+    def flush(self, step_range=None) -> List[Span]:
+        """Drain buffered spans to the handlers.  ``step_range=(lo, hi)``
+        flushes only spans with ``lo <= step < hi``; out-of-window spans
+        stay buffered (they belong to a window someone else will flush)."""
         with self._lock:
-            spans = list(self._spans)
-            self._spans.clear()
+            if step_range is None:
+                spans = list(self._spans)
+                self._spans.clear()
+            else:
+                lo, hi = step_range
+                spans = [s for s in self._spans if lo <= s.step < hi]
+                kept = [s for s in self._spans if not (lo <= s.step < hi)]
+                self._spans.clear()
+                self._spans.extend(kept)
         for h in self._handlers:
             h(spans)
         return spans

@@ -265,10 +265,13 @@ class PipeEngine:
         # needs only the dependency order)
         import contextlib
 
+        from .. import telemetry as _tel
         from ..ndtimeline import predefined as _metrics
         from ..ndtimeline.api import is_active, ndtimeit
 
         _nd_active = is_active()  # snapshot: dormant profiler costs nothing
+        _tel_active = _tel.is_active()  # same gate for the metrics registry
+        _t_sched0 = time.perf_counter() if _tel_active else 0.0
         _metric_of = {
             InstructionKind.FORWARD: _metrics.FORWARD_COMPUTE,
             InstructionKind.BACKWARD: _metrics.BACKWARD_COMPUTE,
@@ -311,13 +314,29 @@ class PipeEngine:
                         t0 = time.perf_counter()
                         with span:
                             jax.block_until_ready(run(ins))
-                        timer(ins, time.perf_counter() - t0)
+                        dt = time.perf_counter() - t0
+                        if _tel_active:
+                            # blocked instructions give true per-kind device
+                            # latency — the profiling-mode histogram feed
+                            _tel.observe(
+                                f"pipe_instr_{ins.kind.name.lower()}_seconds", dt
+                            )
+                        timer(ins, dt)
                     pos[s] += 1
                     progressed = True
             if not progressed:
                 stuck = [q[p] for p, q in zip(pos, queues) if p < len(q)]
                 raise RuntimeError(f"pipeline schedule deadlock; waiting on {stuck[:8]}")
 
+        if _tel_active:
+            # un-blocked instructions are async dispatches, so the honest
+            # whole-schedule signal is the pass duration + instruction count
+            _tel.count("pipe_forward_backward_total")
+            _tel.count("pipe_instructions_total", sum(len(q) for q in queues))
+            _tel.set_gauge("pipe_num_microbatches", M)
+            _tel.observe(
+                "pipe_forward_backward_seconds", time.perf_counter() - _t_sched0
+            )
         mean_loss = sum(losses.values()) / M if losses else None
         if forward_only:
             outs = (

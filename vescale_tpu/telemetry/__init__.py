@@ -1,0 +1,61 @@
+"""vescale_tpu.telemetry — unified runtime telemetry.
+
+Three observability signals, one pipeline (docs/observability.md):
+
+  1. **Metrics registry** (registry.py): counters / gauges / rolling-window
+     histograms fed per-step by the train step, pipe engine,
+     DistributedOptimizer and checkpoint layer.
+  2. **Compile-time step reports** (step_report.py): one JSON per compiled
+     program — FLOPs, peak HBM, argument/output/temp bytes, collective
+     counts (shared counter with debug/comm_mode).
+  3. **Exporters** (exporters.py): per-step JSONL stream, Prometheus text
+     exposition, human-readable dashboard — plus a **straggler detector**
+     (straggler.py) over the ndtimeline streamer's cross-rank spans.
+
+Gating contract (same as ndtimeline): a run that never calls
+``telemetry.init()`` pays zero overhead — no registry, no locks, no files.
+"""
+
+from .api import (
+    count,
+    dashboard,
+    get_registry,
+    get_state,
+    init,
+    is_active,
+    observe,
+    prometheus_dump,
+    record_step,
+    set_gauge,
+    shutdown,
+    write_step_report,
+)
+from .exporters import JsonlExporter, parse_prometheus_text, prometheus_text
+from .registry import Counter, Gauge, Histogram, MetricsRegistry
+from .step_report import build_step_report, read_step_report
+from .straggler import StragglerDetector
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_active",
+    "get_state",
+    "get_registry",
+    "record_step",
+    "observe",
+    "count",
+    "set_gauge",
+    "write_step_report",
+    "prometheus_dump",
+    "dashboard",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlExporter",
+    "prometheus_text",
+    "parse_prometheus_text",
+    "build_step_report",
+    "read_step_report",
+    "StragglerDetector",
+]

@@ -1,0 +1,196 @@
+"""Telemetry global API — the zero-overhead gate.
+
+Mirrors the ndtimeline activation contract (ndtimeline/api.py): the runtime
+wiring (train step, pipe engine, optimizer, checkpoint) calls the helpers
+here on every operation, and a run that never calls ``telemetry.init()``
+must pay nothing — ``is_active()`` is a single module-global check, no
+registry, no ring buffers, no locks, no files are ever created.
+
+    from vescale_tpu import telemetry
+
+    telemetry.init(out_dir="/tmp/run0")        # flip the gate
+    ... train ...                              # steps stream to steps.jsonl
+    print(telemetry.dashboard())               # human summary
+    telemetry.prometheus_dump()                # prometheus text exposition
+    telemetry.shutdown()
+
+Per-step records land in ``<out_dir>/steps.jsonl`` (one JSON object per
+step); ``write_step_report`` drops compile-time program reports next to
+them.  All helpers are no-ops (returning None) while dormant.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+from .exporters import JsonlExporter, dashboard as _dashboard, prometheus_text
+from .registry import MetricsRegistry
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_active",
+    "get_state",
+    "get_registry",
+    "record_step",
+    "observe",
+    "count",
+    "set_gauge",
+    "write_step_report",
+    "prometheus_dump",
+    "dashboard",
+]
+
+
+class TelemetryState:
+    """Everything a live telemetry run owns.  Exists ONLY between ``init``
+    and ``shutdown`` — its absence IS the off state."""
+
+    def __init__(
+        self,
+        out_dir: Optional[str],
+        rank: int,
+        window: int,
+        jsonl: bool,
+    ):
+        self.out_dir = out_dir
+        self.rank = rank
+        self.registry = MetricsRegistry(default_window=window)
+        self.step = 0
+        self.jsonl: Optional[JsonlExporter] = None
+        if jsonl and out_dir is not None:
+            os.makedirs(out_dir, exist_ok=True)
+            self.jsonl = JsonlExporter(os.path.join(out_dir, "steps.jsonl"))
+
+
+_STATE: Optional[TelemetryState] = None
+
+
+def init(
+    out_dir: Optional[str] = None,
+    rank: int = 0,
+    window: int = 1024,
+    jsonl: bool = True,
+) -> TelemetryState:
+    """Activate telemetry.  ``out_dir=None`` keeps everything in-memory
+    (registry only — no JSONL stream, no report files).  Re-initializing
+    while active closes the previous state's stream first (its registry is
+    discarded)."""
+    global _STATE
+    if _STATE is not None:
+        shutdown()
+    _STATE = TelemetryState(out_dir, rank, window, jsonl)
+    return _STATE
+
+
+def shutdown() -> None:
+    """Deactivate and release the gate; flushes/closes the JSONL stream."""
+    global _STATE
+    if _STATE is not None and _STATE.jsonl is not None:
+        _STATE.jsonl.close()
+    _STATE = None
+
+
+def is_active() -> bool:
+    return _STATE is not None
+
+
+def get_state() -> Optional[TelemetryState]:
+    return _STATE
+
+
+def get_registry() -> Optional[MetricsRegistry]:
+    return _STATE.registry if _STATE is not None else None
+
+
+# ------------------------------------------------------------- hot helpers
+# Each is a one-branch no-op while dormant: the runtime wiring calls these
+# unconditionally and un-instrumented runs must not allocate or lock.
+
+def record_step(metrics: Dict[str, Any]) -> None:
+    """Ingest one training step's metrics (the train.py feed).
+
+    Conventions: ``step_time_s`` feeds the step-time histogram,
+    ``tokens`` the throughput counters, scalar floats become gauges.  The
+    full record (plus ``step``/``rank``/``ts``) appends to steps.jsonl."""
+    st = _STATE
+    if st is None:
+        return
+    st.step = int(metrics.get("step", st.step + 1))
+    reg = st.registry
+    reg.counter("train_steps_total").inc()
+    if "step_time_s" in metrics:
+        reg.histogram("train_step_time_seconds").observe(metrics["step_time_s"])
+    if "tokens" in metrics:
+        reg.counter("train_tokens_total").inc(metrics["tokens"])
+    if "tokens_per_sec" in metrics:
+        reg.gauge("train_tokens_per_sec").set(metrics["tokens_per_sec"])
+    for key, gname in (
+        ("loss", "train_loss"),
+        ("grad_norm", "train_grad_norm"),
+        ("loss_scale", "train_loss_scale"),
+        ("skip_count", "train_skipped_steps"),
+    ):
+        if key in metrics and metrics[key] is not None:
+            reg.gauge(gname).set(float(metrics[key]))
+    if metrics.get("overflow"):
+        reg.counter("train_overflow_steps_total").inc()
+    if st.jsonl is not None:
+        st.jsonl.emit({"step": st.step, "rank": st.rank, "ts": time.time(), **metrics})
+
+
+def observe(name: str, value: float) -> None:
+    if _STATE is not None:
+        _STATE.registry.histogram(name).observe(value)
+
+
+def count(name: str, n: float = 1) -> None:
+    if _STATE is not None:
+        _STATE.registry.counter(name).inc(n)
+
+
+def set_gauge(name: str, value: float) -> None:
+    if _STATE is not None:
+        _STATE.registry.gauge(name).set(value)
+
+
+# ----------------------------------------------------------------- outputs
+def write_step_report(name: str, fn: Callable, *args, **kwargs) -> Optional[Dict]:
+    """Build a compile-time step report (see step_report.py) and — when an
+    ``out_dir`` is configured — persist it as ``<out_dir>/<name>_report.json``.
+    No-op while dormant."""
+    st = _STATE
+    if st is None:
+        return None
+    from .step_report import build_step_report, write_step_report as _write
+
+    report = build_step_report(fn, *args, name=name, **kwargs)
+    if st.out_dir is not None:
+        _write(report, os.path.join(st.out_dir, f"{name}_report.json"))
+    if report.get("flops") is not None:
+        st.registry.gauge(f"step_report_{name}_flops").set(report["flops"])
+    if report.get("peak_bytes") is not None:
+        st.registry.gauge(f"step_report_{name}_peak_bytes").set(report["peak_bytes"])
+    return report
+
+
+def prometheus_dump(path: Optional[str] = None) -> Optional[str]:
+    """Prometheus text exposition of the live registry; writes to ``path``
+    (default ``<out_dir>/metrics.prom``) when an out_dir is configured.
+    Returns the text, or None while dormant."""
+    st = _STATE
+    if st is None:
+        return None
+    text = prometheus_text(st.registry)
+    target = path or (os.path.join(st.out_dir, "metrics.prom") if st.out_dir else None)
+    if target is not None:
+        os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+        with open(target, "w") as f:
+            f.write(text)
+    return text
+
+
+def dashboard(title: str = "vescale_tpu telemetry") -> Optional[str]:
+    return _dashboard(_STATE.registry, title) if _STATE is not None else None

@@ -1,0 +1,156 @@
+"""Telemetry exporters: per-step JSONL, Prometheus text exposition, and a
+human-readable dashboard string.
+
+All three read the same ``MetricsRegistry`` snapshot; the JSONL exporter
+additionally receives each per-step record as it is emitted (the stream a
+dashboard tails), so offline analysis never has to reconstruct steps from
+registry aggregates.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+from typing import Dict, Optional, TextIO
+
+from .registry import MetricsRegistry
+
+__all__ = [
+    "JsonlExporter",
+    "prometheus_text",
+    "parse_prometheus_text",
+    "dashboard",
+    "sanitize_metric_name",
+]
+
+# Prometheus metric-name charset: [a-zA-Z_:][a-zA-Z0-9_:]*
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
+# one exposition line: name{labels}? value  (value: float/int/NaN/+-Inf)
+_PROM_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(-?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|NaN|[+-]?Inf))$"
+)
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Map an internal metric name onto the Prometheus charset."""
+    if _NAME_OK.match(name):
+        return name
+    fixed = _NAME_FIX.sub("_", name)
+    if not re.match(r"[a-zA-Z_:]", fixed[:1] or "_"):
+        fixed = "_" + fixed
+    return fixed
+
+
+class JsonlExporter:
+    """Appends one JSON object per record to ``path``.  Opens lazily and
+    flushes per line — a crashed run keeps every completed step, and a tail
+    -f dashboard sees lines as they land."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh: Optional[TextIO] = None
+        self._lock = threading.Lock()
+        self.emitted = 0
+
+    def emit(self, record: Dict) -> None:
+        line = json.dumps(record, default=str)
+        with self._lock:
+            if self._fh is None:
+                os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+                self._fh = open(self.path, "a")
+            self._fh.write(line + "\n")
+            self._fh.flush()
+            self.emitted += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def prometheus_text(registry: MetricsRegistry) -> str:
+    """Render the registry in the Prometheus text exposition format
+    (version 0.0.4).  Histograms export as summaries: ``{quantile="..."}``
+    series plus ``_count``/``_sum``."""
+    snap = registry.snapshot()
+    lines = []
+    for name in sorted(snap["counters"]):
+        pname = sanitize_metric_name(name)
+        lines.append(f"# TYPE {pname} counter")
+        lines.append(f"{pname} {_fmt(snap['counters'][name])}")
+    for name in sorted(snap["gauges"]):
+        pname = sanitize_metric_name(name)
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname} {_fmt(snap['gauges'][name])}")
+    for name in sorted(snap["histograms"]):
+        pname = sanitize_metric_name(name)
+        h = snap["histograms"][name]
+        lines.append(f"# TYPE {pname} summary")
+        for label, q in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
+            if label in h:
+                lines.append(f'{pname}{{quantile="{q}"}} {_fmt(h[label])}')
+        lines.append(f"{pname}_count {_fmt(h['count'])}")
+        lines.append(f"{pname}_sum {_fmt(h['sum'])}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"  # canonical Prometheus spelling (repr gives 'nan')
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def parse_prometheus_text(text: str) -> Dict[str, float]:
+    """Strict line-format parser for the exposition format this module
+    emits (and any simple single-label exposition).  Returns
+    ``{series: value}`` with the label set kept in the key.  Raises
+    ``ValueError`` on any non-comment line it cannot parse — the validation
+    half of the telemetry smoke test."""
+    out: Dict[str, float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        if m is None:
+            raise ValueError(f"prometheus text line {lineno} unparseable: {line!r}")
+        name, labels, value = m.groups()
+        out[name + (labels or "")] = float(value)
+    return out
+
+
+def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
+    """Human-readable fixed-width dump of the registry (the quick-look
+    answer to 'how is this run doing' without any external stack)."""
+    snap = registry.snapshot()
+    width = 78
+    lines = ["=" * width, f"{title:^{width}}", "=" * width]
+    if snap["counters"]:
+        lines.append("counters:")
+        for name in sorted(snap["counters"]):
+            lines.append(f"  {name:<48} {_fmt(snap['counters'][name]):>12}")
+    if snap["gauges"]:
+        lines.append("gauges:")
+        for name in sorted(snap["gauges"]):
+            lines.append(f"  {name:<48} {snap['gauges'][name]:>12.6g}")
+    if snap["histograms"]:
+        lines.append("histograms (rolling window):")
+        for name in sorted(snap["histograms"]):
+            h = snap["histograms"][name]
+            if h.get("count"):
+                lines.append(
+                    f"  {name:<38} n={h['count']:<7} p50={h.get('p50', 0):.6g} "
+                    f"p95={h.get('p95', 0):.6g} p99={h.get('p99', 0):.6g}"
+                )
+            else:
+                lines.append(f"  {name:<38} n=0")
+    lines.append("=" * width)
+    return "\n".join(lines)

@@ -1,0 +1,189 @@
+"""Metrics registry — counters, gauges, rolling-window histograms.
+
+The runtime-facing half of the telemetry subsystem: ``train.py``, the pipe
+engine, the DistributedOptimizer and the checkpoint layer feed one
+process-global ``MetricsRegistry`` (created ONLY by ``telemetry.init()`` —
+see api.py for the zero-overhead gate).  Exporters (exporters.py) read a
+consistent ``snapshot()``.
+
+Design notes:
+  - Histograms keep a bounded rolling window (deque) for percentiles plus
+    monotonic count/sum totals, so a long run's p50/p95/p99 track RECENT
+    behavior (a warmup-step outlier ages out) while rates stay exact.
+  - Percentiles use the nearest-rank method (the same convention as
+    ndtimeline/parser_handler.py — int(n*q) would report the max at small n).
+  - Thread-safe: handlers may observe from io/streamer threads.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Union
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
+
+Number = Union[int, float]
+
+
+class Counter:
+    """Monotonic counter (Prometheus 'counter' semantics)."""
+
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: Number = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name}: negative increment {n}")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-value gauge (may go up or down)."""
+
+    __slots__ = ("name", "help", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+
+    def set(self, v: Number) -> None:
+        self._value = float(v)
+
+    def inc(self, n: Number = 1) -> None:
+        self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Rolling-window histogram: percentiles over the last ``window``
+    observations, exact monotonic count/sum over the whole run."""
+
+    __slots__ = ("name", "help", "window", "_values", "_pos", "_filled", "_count", "_sum", "_lock")
+
+    def __init__(self, name: str, help: str = "", window: int = 1024):
+        if window < 1:
+            raise ValueError(f"histogram {name}: window must be >= 1")
+        self.name = name
+        self.help = help
+        self.window = window
+        self._values: List[float] = [0.0] * window  # preallocated ring
+        self._pos = 0
+        self._filled = 0
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, v: Number) -> None:
+        v = float(v)
+        with self._lock:
+            self._values[self._pos] = v
+            self._pos = (self._pos + 1) % self.window
+            self._filled = min(self._filled + 1, self.window)
+            self._count += 1
+            self._sum += v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def _sorted_window(self):
+        """(sorted recent values, count, sum) under the lock."""
+        with self._lock:
+            n = self._filled
+            xs = sorted(self._values[:n] if n < self.window else self._values)
+            return xs, self._count, self._sum
+
+    @staticmethod
+    def _nearest_rank(xs: List[float], q: float) -> float:
+        return xs[max(0, math.ceil(len(xs) * q) - 1)]
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Nearest-rank percentile of the rolling window; None when empty."""
+        xs, _, _ = self._sorted_window()
+        return self._nearest_rank(xs, q) if xs else None
+
+    def snapshot(self) -> Dict[str, float]:
+        xs, count, total = self._sorted_window()
+        out: Dict[str, float] = {"count": count, "sum": total, "window": len(xs)}
+        if xs:
+            for label, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+                out[label] = self._nearest_rank(xs, q)
+            out["min"] = xs[0]
+            out["max"] = xs[-1]
+            out["mean"] = (total / count) if count else 0.0
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create registry of named metrics.  A name is bound to one
+    metric kind for the registry's lifetime — re-requesting it with another
+    kind raises instead of silently shadowing."""
+
+    def __init__(self, default_window: int = 1024):
+        self.default_window = default_window
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name: str, cls, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, **kwargs)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}, "
+                    f"requested {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, Gauge, help=help)
+
+    def histogram(self, name: str, help: str = "", window: Optional[int] = None) -> Histogram:
+        return self._get_or_create(
+            name, Histogram, help=help, window=window or self.default_window
+        )
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def get(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """Consistent read of every metric, grouped by kind."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: Dict[str, Dict] = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in items:
+            if isinstance(m, Counter):
+                out["counters"][name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.value
+            elif isinstance(m, Histogram):
+                out["histograms"][name] = m.snapshot()
+        return out

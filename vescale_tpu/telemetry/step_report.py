@@ -1,0 +1,119 @@
+"""Compile-time step reports.
+
+One JSON artifact per compiled program combining the two static views the
+stack already half-produces: ``debug/comm_mode`` collective counts and XLA's
+cost/memory analysis (``compiled.cost_analysis()`` / ``memory_analysis()``).
+Generated ONCE per program (compile-time, not per-step): the report answers
+"what does a step cost" — FLOPs, peak HBM, argument/output/temp bytes, and
+how many of each collective the partitioner inserted — before any step runs.
+
+The collective counts here and ``debug.comm_mode.comm_counts`` are computed
+by the same counter over the same optimized-HLO text, so they agree by
+construction on the same program (the acceptance contract the smoke test
+asserts).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from ..debug.comm_mode import count_collectives
+
+__all__ = ["build_step_report", "write_step_report", "read_step_report"]
+
+
+def _cost_dict(compiled) -> Dict[str, Any]:
+    """Normalize ``compiled.cost_analysis()`` across jax versions (dict on
+    new, list-of-dict per partition on older)."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost) if cost else {}
+
+
+def build_step_report(
+    fn: Callable,
+    *args,
+    static_argnums=(),
+    name: str = "step",
+    **kwargs,
+) -> Dict[str, Any]:
+    """Lower+compile ``fn(*args, **kwargs)`` (or reuse ``fn.lower`` when fn
+    is already jitted — e.g. the step from ``make_train_step``) and distill
+    the compiled program into a JSON-serializable report.
+
+    Keys: ``flops``, ``bytes_accessed``, ``peak_bytes`` (argument + output +
+    temp - aliased: the program's HBM high-water mark as XLA accounts it),
+    ``argument_bytes``/``output_bytes``/``temp_bytes``/``alias_bytes``/
+    ``generated_code_bytes``, and ``collectives`` (the comm_mode counter over
+    the optimized HLO).  Fields XLA cannot provide on a backend come back
+    None rather than raising — the report must degrade, not fail a run."""
+    if hasattr(fn, "lower"):
+        lowered = fn.lower(*args, **kwargs)
+    else:
+        lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args, **kwargs)
+    report: Dict[str, Any] = {
+        "name": name,
+        "platform": jax.devices()[0].platform,
+        "num_devices": len(jax.devices()),
+    }
+    try:
+        compiled = lowered.compile()
+    except Exception as e:  # unpartitionable/abstract program: static views only
+        report.update(
+            flops=None,
+            bytes_accessed=None,
+            peak_bytes=None,
+            collectives=count_collectives(lowered.as_text()),
+            compile_error=repr(e),
+        )
+        return report
+    cost = _cost_dict(compiled)
+    report["flops"] = float(cost["flops"]) if "flops" in cost else None
+    report["bytes_accessed"] = (
+        float(cost["bytes accessed"]) if "bytes accessed" in cost else None
+    )
+    mem = None
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        pass
+    for key, attr in (
+        ("argument_bytes", "argument_size_in_bytes"),
+        ("output_bytes", "output_size_in_bytes"),
+        ("temp_bytes", "temp_size_in_bytes"),
+        ("alias_bytes", "alias_size_in_bytes"),
+        ("generated_code_bytes", "generated_code_size_in_bytes"),
+    ):
+        report[key] = getattr(mem, attr, None) if mem is not None else None
+    peak = getattr(mem, "peak_memory_in_bytes", None) if mem is not None else None
+    if peak is None and mem is not None:
+        parts = [report["argument_bytes"], report["output_bytes"], report["temp_bytes"]]
+        if all(p is not None for p in parts):
+            peak = sum(parts) - (report["alias_bytes"] or 0)
+    report["peak_bytes"] = peak
+    try:
+        text = compiled.as_text()
+    except Exception:
+        text = lowered.as_text()
+    report["collectives"] = count_collectives(text)
+    return report
+
+
+def write_step_report(report: Dict[str, Any], path: str) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    return path
+
+
+def read_step_report(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,131 @@
+"""Straggler detection over cross-rank merged ndtimeline spans.
+
+A ``StragglerDetector`` is a span handler (the ``NDtimelineStreamer``
+handler interface: ``handler(List[Span])``) that accumulates per-(metric,
+rank) durations and flags ranks whose latency exceeds a configurable
+multiple of the cross-rank MEDIAN for that metric.  Median (not mean): one
+slow rank must not drag the baseline toward itself — on an 8-rank job a
+2x-slow rank shifts the mean by 12.5% but the median not at all.
+
+It also consumes the offline shape: ``update_from_merged`` takes the
+``parser_handler.merge_ranks`` rollup, so post-hoc analysis of raw span
+dumps uses the same thresholds as the live collector path.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional
+
+__all__ = ["StragglerDetector"]
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+class StragglerDetector:
+    """Flags per-metric slow ranks.
+
+    ``threshold``: a rank is a straggler for a metric when its mean recent
+    duration exceeds ``threshold * median`` of all ranks' means (and the
+    absolute excess tops ``min_excess_ms`` — microsecond-scale jitter on
+    microsecond-scale metrics is not a health signal).
+    ``window``: per-(metric, rank) rolling sample count.
+    ``min_ranks``: below this many reporting ranks there is no population to
+    compare against; nothing is flagged.
+    """
+
+    def __init__(
+        self,
+        threshold: float = 1.5,
+        window: int = 256,
+        min_ranks: int = 2,
+        min_excess_ms: float = 0.0,
+    ):
+        if threshold <= 1.0:
+            raise ValueError(f"threshold must be > 1.0, got {threshold}")
+        self.threshold = float(threshold)
+        self.window = int(window)
+        self.min_ranks = int(min_ranks)
+        self.min_excess_ms = float(min_excess_ms)
+        # metric -> rank -> rolling durations (ms)
+        self._samples: Dict[str, Dict[int, collections.deque]] = {}
+        self._lock = threading.Lock()
+        self.spans_seen = 0
+
+    # -------------------------------------------------------------- feeds
+    def __call__(self, spans) -> None:
+        """Streamer/flush handler: ingest a span batch."""
+        with self._lock:
+            for s in spans:
+                dq = self._samples.setdefault(s.metric, {}).setdefault(
+                    s.rank, collections.deque(maxlen=self.window)
+                )
+                dq.append(s.duration * 1e3)
+                self.spans_seen += 1
+
+    def update_from_merged(self, merged: Dict[tuple, Dict]) -> None:
+        """Ingest a ``parser_handler.merge_ranks`` rollup: ``{(step, metric):
+        {"per_rank_ms": {rank: total_ms}, ...}}`` — each (step, rank) total
+        counts as one sample."""
+        with self._lock:
+            for (_step, metric), row in merged.items():
+                for rank, ms in row.get("per_rank_ms", {}).items():
+                    dq = self._samples.setdefault(metric, {}).setdefault(
+                        int(rank), collections.deque(maxlen=self.window)
+                    )
+                    dq.append(float(ms))
+                    self.spans_seen += 1
+
+    # ------------------------------------------------------------ queries
+    def rank_means(self, metric: str) -> Dict[int, float]:
+        with self._lock:
+            per_rank = self._samples.get(metric, {})
+            return {r: sum(dq) / len(dq) for r, dq in per_rank.items() if dq}
+
+    def report(self, metric: Optional[str] = None) -> List[Dict]:
+        """Flagged stragglers, worst ratio first.  Each entry:
+        ``{metric, rank, mean_ms, median_ms, ratio}``."""
+        with self._lock:
+            metrics = [metric] if metric is not None else list(self._samples)
+        out: List[Dict] = []
+        for m in metrics:
+            means = self.rank_means(m)
+            if len(means) < self.min_ranks:
+                continue
+            med = _median(list(means.values()))
+            if med <= 0.0:
+                continue
+            for rank, mean in means.items():
+                if mean > self.threshold * med and (mean - med) >= self.min_excess_ms:
+                    out.append(
+                        {
+                            "metric": m,
+                            "rank": rank,
+                            "mean_ms": mean,
+                            "median_ms": med,
+                            "ratio": mean / med,
+                        }
+                    )
+        out.sort(key=lambda e: e["ratio"], reverse=True)
+        return out
+
+    def healthy(self) -> bool:
+        return not self.report()
+
+    def summary(self) -> str:
+        flagged = self.report()
+        if not flagged:
+            return "stragglers: none"
+        lines = ["stragglers:"]
+        for e in flagged:
+            lines.append(
+                f"  rank {e['rank']:<4} {e['metric']:<28} "
+                f"{e['mean_ms']:.3f} ms vs median {e['median_ms']:.3f} ms "
+                f"({e['ratio']:.2f}x)"
+            )
+        return "\n".join(lines)

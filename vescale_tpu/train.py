@@ -11,6 +11,7 @@ with compute (the role of the reference's async bucket machinery).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -32,6 +33,7 @@ def make_train_step(
     rng_streams: tuple = ("dropout",),
     grad_accum_steps: int = 1,
     auto_inc_step: bool = True,
+    with_metrics: Optional[bool] = None,
 ):
     """Build ``train_step(params, opt_state, batch, step_key) ->
     (params, opt_state, loss)``.
@@ -68,9 +70,23 @@ def make_train_step(
     collection through apply, keeps it away from the optimizer, and
     OVERWRITES it with its gradient (the fp8 delayed-scaling update) under
     a finite guard so skipped overflow steps cannot poison the histories.
+
+    ``with_metrics``: the telemetry feed (telemetry/).  When True the
+    compiled step additionally computes per-step scalars — grad-norm, and
+    with a DistributedOptimizer the live loss-scale value and skipped-step
+    count — returned OUT-OF-BAND: the wrapper strips them from the public
+    return and forwards them (plus wall-clock step time, loss, tokens/sec)
+    to ``telemetry.record_step``.  ``None`` (default) resolves to
+    ``telemetry.is_active()`` at BUILD time, so a run that calls
+    ``telemetry.init()`` before ``make_train_step`` gets the full feed and
+    an un-instrumented run compiles the exact unchanged program — the
+    zero-overhead gating contract.
     """
+    from . import telemetry as _tel
     from .parallel.optimizer import BasicOptimizer, DistributedOptimizer
 
+    if with_metrics is None:
+        with_metrics = _tel.is_active()
     dopt = tx if isinstance(tx, (BasicOptimizer, DistributedOptimizer)) else None
     OWG = "_overwrite_with_gradient"
 
@@ -203,6 +219,24 @@ def make_train_step(
             updates, new_opt_state = tx.update(grads_p, opt_state, params_p)
             new_params_p = optax.apply_updates(params_p, updates)
         new_params = {"params": new_params_p, OWG: owg_new} if fp8_bundle else new_params_p
+        if with_metrics:
+            # out-of-band telemetry scalars (stripped by the wrapper below).
+            # grad-norm is reported UNSCALED — grads under loss scaling carry
+            # the scale factor, which is an implementation detail, not signal.
+            gnorm = optax.global_norm(
+                jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads_p)
+            )
+            if isinstance(dopt, DistributedOptimizer):
+                gnorm = gnorm / dopt.current_scale(opt_state)
+            tmetrics = {"grad_norm": gnorm}
+            if isinstance(new_opt_state, dict) and "loss_scale" in new_opt_state:
+                ls = new_opt_state["loss_scale"]
+                tmetrics["loss_scale"] = ls["scale"]
+                if "skip_count" in ls:
+                    tmetrics["skip_count"] = ls["skip_count"]
+            if has_aux:
+                return new_params, new_opt_state, loss, aux, tmetrics
+            return new_params, new_opt_state, loss, tmetrics
         if has_aux:
             return new_params, new_opt_state, loss, aux
         return new_params, new_opt_state, loss
@@ -222,10 +256,38 @@ def make_train_step(
 
     @functools.wraps(jitted)
     def timed_step(*args, **kwargs):
+        t0 = time.perf_counter()
         with _nd.ndtimeit(TRAIN_STEP):
             out = jitted(*args, **kwargs)
         if auto_inc_step and _nd.is_active():
             _nd.get_manager().inc_step()
+        if with_metrics:
+            # the telemetry scalars ride as a trailing pytree; strip them
+            # unconditionally so the public return shape never depends on
+            # whether telemetry is live at CALL time
+            tmetrics = out[-1]
+            out = out[:-1]
+        else:
+            tmetrics = None
+        if _tel.is_active():
+            # host-fetching the loss forces this step's completion, so the
+            # recorded time is true wall clock, not async dispatch time —
+            # the observability trade a telemetry-on run opts into
+            loss_val = float(out[2])
+            dt = time.perf_counter() - t0
+            rec: Dict[str, Any] = {"step_time_s": dt, "loss": loss_val}
+            batch = args[2] if len(args) > 2 else kwargs.get("batch")
+            leaf = batch.get("input") if isinstance(batch, dict) else None
+            if leaf is not None and hasattr(leaf, "shape"):
+                tokens = 1
+                for s in leaf.shape:
+                    tokens *= int(s)
+                rec["tokens"] = tokens
+                if dt > 0:
+                    rec["tokens_per_sec"] = tokens / dt
+            if tmetrics:
+                rec.update({k: float(v) for k, v in tmetrics.items()})
+            _tel.record_step(rec)
         return out
 
     # keep the jit surface (lower/trace inspection) reachable
