@@ -354,6 +354,14 @@ def _dispatch():
         bench_moe()
     elif which == "longctx":
         bench_longctx()
+    elif which == "redistribute":
+        # multi-hop planner battery (VESCALE_BENCH=redistribute): plan
+        # length, bytes moved and retrace count per representative
+        # transition pair — scripts/redistribute_bench.py emits the line
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import redistribute_bench
+
+        print(json.dumps(redistribute_bench.run_bench()))
     else:
         main()
 

@@ -531,6 +531,66 @@ def test_ndtimeline_runtime_wiring_chrome_trace(tmp_path, mesh2d):
     assert row["max_ms"] >= row["mean_ms"] > 0
 
 
+def test_auto_inc_step_double_increment_warns_once():
+    """ISSUE 2 satellite (ADVICE double-increment hazard): with
+    auto_inc_step=True (default), a loop that ALSO advances the ndtimeline
+    counter manually between steps double-counts the global step — the
+    train step detects the externally-moved counter and warns exactly
+    ONCE; a clean auto-only loop never warns."""
+    import warnings
+
+    import flax.linen as nn
+    import optax
+
+    from vescale_tpu.dmodule import parallelize_module
+    from vescale_tpu.ndtimeline import api as nd
+    from vescale_tpu.train import make_train_step
+
+    import vescale_tpu.train as train_mod
+
+    mesh = vt.DeviceMesh(("dp",), (8,))
+    mgr = nd.init_ndtimers(rank=0)
+    train_mod._AUTO_STEP_GUARD.update(mgr=None, step=None, warned=False)
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, deterministic=True):
+            return nn.Dense(4)(x)
+
+    dm = parallelize_module(Tiny(), mesh, {"parameter": {r".*": [vt.placements.Replicate()]}})
+    p = dm.init(jax.random.key(0), jnp.ones((8, 4)))["params"]
+    tx = optax.sgd(1e-2)
+    batch = {"input": jnp.ones((8, 4))}
+
+    # clean auto-only loop: no warning
+    step = make_train_step(dm, tx, lambda out, b: jnp.mean(out**2), donate=False)
+    opt_state = tx.init(p)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        step(p, opt_state, batch)
+        step(p, opt_state, batch)
+
+    # a SECOND auto-inc step fn sharing the manager (train + eval loops) is
+    # legitimate — the shared guard must not mistake it for a manual inc
+    step2 = make_train_step(dm, tx, lambda out, b: jnp.mean(out**2), donate=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        step2(p, opt_state, batch)
+        step(p, opt_state, batch)
+        step2(p, opt_state, batch)
+
+    # manual inc_step() alongside auto_inc_step: warn once, keep working
+    nd.inc_step()  # the hazard: counter moves outside the train step
+    with pytest.warns(UserWarning, match="double-counted"):
+        step2(p, opt_state, batch)
+    nd.inc_step()
+    before = mgr.step
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # one-time: no second warning
+        step2(p, opt_state, batch)
+    assert mgr.step == before + 1  # auto inc still advances
+
+
 def test_ndtimeline_runtime_wiring_fast():
     """Fast-lane parity representative of the slow chrome-trace test: a
     single train step + checkpoint save emit TRAIN_STEP /

@@ -526,6 +526,59 @@ def test_async_save_failure_surfaces(tmp_path, mesh1d, monkeypatch):
     assert not os.path.exists(tmp_path / "fail" / "meta.json")
 
 
+def test_drain_mid_flight_save_cannot_commit(tmp_path, mesh1d, monkeypatch):
+    """regression (ISSUE 2 satellite): drain()ing a doomed in-flight async
+    save — the rollback/resave path of manager.py — must NOT let its
+    finalize task write meta.json or fire on_commit rotation afterwards.
+    Data writes are blocked on an event so drain() deterministically lands
+    while the save is in flight; the commit gate + cancelled flag then keep
+    the late finalize from committing once the writes unblock."""
+    import os
+    import threading
+    import time
+
+    from vescale_tpu.checkpoint.storage import FileSystemStorage
+
+    release = threading.Event()
+    orig = FileSystemStorage.write_bytes
+
+    def blocking(self, name, data):
+        if name.startswith("data/"):
+            assert release.wait(timeout=30)
+        return orig(self, name, data)
+
+    monkeypatch.setattr(FileSystemStorage, "write_bytes", blocking)
+    monkeypatch.setenv("VESCALE_NATIVE_CKPT_IO", "0")  # route through python io
+    committed = []
+    d = vt.distribute_tensor(np.arange(16, dtype=np.float32), mesh1d, [Shard(0)])
+    h = ckpt.save(
+        str(tmp_path / "doomed"), {"m": {"x": d}},
+        async_checkpoint=True, on_commit=lambda: committed.append(1),
+    )
+    drained = threading.Thread(target=h.drain)
+    drained.start()  # blocks on the in-flight (event-gated) data writes
+    time.sleep(0.2)  # let drain reach the pool join with writes in flight
+    release.set()
+    drained.join(timeout=30)
+    assert not drained.is_alive()
+    # the writers are joined, but the doomed save neither committed nor
+    # fired rotation — and never will (finalize saw the cancelled flag)
+    time.sleep(0.5)
+    assert not os.path.exists(tmp_path / "doomed" / "meta.json")
+    assert not committed
+    # the path stays usable: a fresh save to the same dir commits normally
+    h2 = ckpt.save(
+        str(tmp_path / "doomed"), {"m": {"x": d}},
+        async_checkpoint=True, on_commit=lambda: committed.append(2),
+    )
+    h2.wait()
+    deadline = time.time() + 20
+    while time.time() < deadline and not committed:
+        time.sleep(0.1)
+    assert committed == [2]
+    assert os.path.exists(tmp_path / "doomed" / "meta.json")
+
+
 def test_native_ckpt_writer(tmp_path, mesh1d, monkeypatch):
     """The C++ chunk writer (checkpoint/native/ckpt_io.cpp) builds, writes
     atomically (tmp+fsync+rename), and the python pool takes over when

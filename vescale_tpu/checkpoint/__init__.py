@@ -25,6 +25,7 @@ memory store in the single-controller model).
 from __future__ import annotations
 
 import json
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -43,7 +44,14 @@ from .planner import (
     flatten_state,
     key_of_path,
 )
-from .reshard import Box, box_from_index, dense_to_flat_ranges, fill_box_from_chunks, intersect
+from .reshard import (
+    Box,
+    box_from_index,
+    dense_to_flat_ranges,
+    fill_box_from_chunks,
+    intersect,
+    plain_load_spec,
+)
 from .storage import AsyncWriter, FileSystemStorage, MemoryStorage, Storage, bytes_to_array
 
 __all__ = ["save", "load", "CheckpointHandle", "FileSystemStorage", "MemoryStorage", "LAST_LOAD_STATS"]
@@ -93,6 +101,10 @@ class CheckpointHandle:
         self._writer = writer
         self._commit = commit
         self._done = False
+        self._cancelled = False
+        # serializes drain's cancellation against the async _finalize's
+        # commit: once drain holds the gate, no commit can START
+        self._commit_gate = threading.Lock()
         self.error: Optional[BaseException] = None
 
     @property
@@ -102,10 +114,18 @@ class CheckpointHandle:
     def drain(self) -> None:
         """Join every io worker of this save — even a FAILED one — so no
         late chunk write can land after the caller reuses or clears the
-        target dir.  Never raises: a failed save's error is already
-        recorded (``error``); this only stops its writers."""
+        target dir, WITHOUT committing: a doomed in-flight save drained
+        during rollback/resave (manager.py) must not write meta.json or
+        fire on_commit rotation.  The cancelled flag (checked under the
+        commit gate by the async finalize task) plus ``cancel_futures``
+        guarantee no commit starts after drain returns; a commit already
+        in flight is waited out (the caller un-commits the dir next).
+        Never raises: a failed save's error is already recorded
+        (``error``); this only stops its writers."""
+        with self._commit_gate:
+            self._cancelled = True
         try:
-            self._writer.pool.shutdown(wait=True)
+            self._writer.pool.shutdown(wait=True, cancel_futures=True)
         except Exception:
             pass
         try:
@@ -133,11 +153,17 @@ class CheckpointHandle:
             if self.error is None:
                 self.error = e
         if self._commit is not None:
-            try:
-                self._commit(ok=self.error is None)
-            except BaseException as e:
-                if self.error is None:
-                    self.error = e
+            with self._commit_gate:
+                # a drained (cancelled) save must not commit here either —
+                # the multi-process twin of the async finalize's check.  The
+                # manager drains symmetrically on every process, so skipping
+                # the commit (and its barrier) is symmetric too.
+                if not self._cancelled:
+                    try:
+                        self._commit(ok=self.error is None)
+                    except BaseException as e:
+                        if self.error is None:
+                            self.error = e
         self._done = True
         if self.error is not None:
             raise self.error
@@ -296,7 +322,13 @@ def _save_impl(
                 for f in data_futures:
                     f.result()
                 writer.drain_native()  # meta.json may only chase durable chunks
-                _commit()
+                with handle._commit_gate:
+                    # drained mid-flight (rollback/resave): the save is
+                    # doomed — committing would fire on_commit rotation
+                    # against a dir about to be cleared
+                    if handle._cancelled:
+                        return
+                    _commit()
             except BaseException as e:  # surface, don't swallow: a failed
                 # fire-and-forget save must not look committed, leak its io
                 # threads, or die silently on a pool future nobody reads
@@ -373,8 +405,20 @@ def _load_darray(entry, reader: _ChunkReader, target: DArray) -> DArray:
     spec = target.spec
     lay = spec.layout()
     if spec.has_partial() or lay.interleaves:
-        # Partial/Interleaved load templates are debug-only layouts; the
-        # full-assembly fallback keeps them working (single-controller)
+        # Interleaved templates: load shard-by-shard into the plain-Shard
+        # relaxation, then let the redistribute planner/kernels move the
+        # shards into the interleaved layout — O(shard) host AND device
+        # memory, replacing the full-logical host assembly (reshard.py
+        # plain_load_spec).  Partial templates (debug-only) and interleave
+        # layouts outside per-shard kernel scope keep the full-assembly
+        # fallback.
+        mid = plain_load_spec(spec)
+        if mid is not None:
+            from ..redistribute_plan import can_redistribute_per_shard
+
+            if can_redistribute_per_shard(mid, spec):
+                plain = _load_darray(entry, reader, DArray(None, mid))
+                return plain.redistribute(placements=spec.placements)
         return _relayout(_assemble_full(entry, reader), target)
     dtype = np.dtype(entry["dtype"])
     tdtype = np.dtype(target.dtype)

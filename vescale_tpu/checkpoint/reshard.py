@@ -17,7 +17,36 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["Box", "intersect", "chunks_for_spec", "fill_box_from_chunks", "box_from_index"]
+__all__ = [
+    "Box",
+    "intersect",
+    "chunks_for_spec",
+    "fill_box_from_chunks",
+    "box_from_index",
+    "plain_load_spec",
+]
+
+
+def plain_load_spec(spec):
+    """Per-shard-loadable intermediate spec for a template whose local
+    chunks are not contiguous boxes (InterleavedShard): the same mesh with
+    each ``InterleavedShard(d, m)`` relaxed to ``Shard(d)``.
+
+    The loader assembles saved chunks into this plain spec shard-by-shard
+    (contiguous box intersection, O(addressable bytes) host memory), then
+    the redistribute planner moves it into the template layout with
+    per-shard collectives — replacing the full-logical host assembly the
+    interleaved load path used to need.  None when the template has no
+    interleave or is out of scope (partial/ragged)."""
+    from ..placements import InterleavedShard, Shard
+    from ..spec import DArraySpec
+
+    if not spec.layout().interleaves or spec.has_partial() or spec.has_ragged():
+        return None
+    placements = tuple(
+        Shard(p.dim) if isinstance(p, InterleavedShard) else p for p in spec.placements
+    )
+    return DArraySpec(spec.mesh, placements, spec.meta)
 
 
 def box_from_index(idx, shape: Sequence[int]) -> "Box":

@@ -80,6 +80,7 @@ class CommDebugMode:
 
     def __init__(self):
         self.counts: Dict[str, int] = {}
+        self.plan_attribution: Dict[str, Any] = {}
 
     def __enter__(self):
         return self
@@ -109,3 +110,33 @@ class CommDebugMode:
 
     def get_total_counts(self) -> int:
         return self.counts.get("total", 0)
+
+    def attribute_plan(self, plan, compiled: bool = False) -> Dict[str, Any]:
+        """Attribute collectives to the hops of a multi-hop redistribution
+        plan (redistribute_plan.RedistributePlan).
+
+        The static view comes from ``plan_comm_summary`` — the SAME
+        accounting that feeds the telemetry ``redistribute.bytes_moved``
+        gauge, so the two surfaces agree by construction.  With
+        ``compiled=True`` each kernel hop is additionally lowered and its
+        optimized HLO counted through ``count_collectives`` (the shared
+        counter), attached per hop as ``hlo_collectives`` — ground truth
+        for what XLA actually emits on this backend."""
+        from ..redistribute_plan import plan_comm_summary
+
+        summary = plan_comm_summary(plan)
+        if compiled:
+            for hop, rec in zip(plan.hops, summary["hops"]):
+                if hop.fn is None or not hasattr(hop.fn, "lower"):
+                    continue  # reshard/device_put: runtime-chosen pattern
+                arg = jax.ShapeDtypeStruct(
+                    hop.src.layout().physical_shape, hop.src.dtype
+                )
+                lowered = hop.fn.lower(arg)
+                try:
+                    text = lowered.compile().as_text()
+                except Exception:
+                    text = lowered.as_text()
+                rec["hlo_collectives"] = count_collectives(text)
+        self.plan_attribution = summary
+        return summary

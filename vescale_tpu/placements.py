@@ -37,6 +37,7 @@ __all__ = [
     "normalize_placement",
     "normalize_placements",
     "plan_axes",
+    "transition_candidates",
 ]
 
 
@@ -283,6 +284,32 @@ def normalize_placements(placements, mesh_ndim: int, tensor_ndim: Optional[int] 
     if len(out) > mesh_ndim:
         raise ValueError(f"{len(out)} placements for mesh of {mesh_ndim} dims")
     out.extend(Replicate() for _ in range(mesh_ndim - len(out)))
+    return tuple(out)
+
+
+def transition_candidates(src_p: Placement, dst_p: Placement) -> Tuple[Placement, ...]:
+    """Candidate intermediate placements for ONE mesh dim when planning a
+    multi-hop redistribution (redistribute_plan.py).
+
+    The lattice spanned per mesh dim: the two endpoint placements, the
+    plain-``Shard`` relaxation of any ``InterleavedShard`` endpoint (the
+    bridge for merged-QKV interleave changes that differ on several mesh
+    dims at once), and ``Replicate`` — the universal bridge every primitive
+    kernel can reach (gather) and leave (slice/seed).  Kept deliberately
+    small: the planner's node set is the cartesian product across mesh dims,
+    and 3-4 candidates per dim keep a 4-D mesh's lattice under ~300 specs.
+    """
+    out: list = []
+    for p in (src_p, dst_p):
+        if p not in out:
+            out.append(p)
+        if isinstance(p, InterleavedShard):
+            s = Shard(p.dim)
+            if s not in out:
+                out.append(s)
+    r = Replicate()
+    if r not in out:
+        out.append(r)
     return tuple(out)
 
 

@@ -33,7 +33,7 @@ from .mesh import DeviceMesh
 from .placements import normalize_placements
 from .spec import DArraySpec, TensorMeta
 
-__all__ = ["redistribute", "redistribute_local_tensor"]
+__all__ = ["redistribute", "redistribute_local_tensor", "classify_transition"]
 
 
 def redistribute(darr: DArray, placements, mesh: Optional[DeviceMesh] = None) -> DArray:
@@ -80,6 +80,18 @@ def redistribute(darr: DArray, placements, mesh: Optional[DeviceMesh] = None) ->
     if fn is not None:
         return DArray(fn(darr.data), dst)
 
+    # composite transition with no single-hop kernel: the multi-hop planner
+    # (redistribute_plan.py) searches for a short sequence of per-shard hops
+    # — axis-swap cycles, Partial/reshard combinations, multi-dim interleave
+    # changes, cross-mesh bridges — whose intermediates stay within a small
+    # multiple of the shard size.  Plans are memoized with their jitted hop
+    # fns: repeated boundary transitions re-plan and retrace nothing.
+    from .redistribute_plan import plan_redistribute
+
+    plan = plan_redistribute(src, dst)
+    if plan is not None:
+        return DArray(plan.execute(darr.data), dst)
+
     # cross-mesh without logical materialization: strip each side to a
     # plain physical==logical form with SAME-mesh per-shard kernels, then
     # let the runtime reshard device-to-device (jax.device_put between
@@ -91,13 +103,58 @@ def redistribute(darr: DArray, placements, mesh: Optional[DeviceMesh] = None) ->
         if out is not None:
             return out
 
-    # fallback (nested shards, exotic cross-mesh): pack∘unpack, jit-compiled
-    # with the destination sharding where possible.  The logical value may
-    # materialize: surface that loudly (VERDICT r4 next #9) and hard-fail
-    # under VESCALE_STRICT_REDISTRIBUTE=1.
+    # fallback (nested+padded shards, out-of-budget ragged moves, exotic
+    # cross-mesh): pack∘unpack, jit-compiled with the destination sharding
+    # where possible.  The logical value may materialize: surface that
+    # loudly — including WHY the planner declined — and hard-fail under
+    # VESCALE_STRICT_REDISTRIBUTE=1.
     _warn_fallback(src, dst)
     phys = fallback_fn(src, dst)(darr.data)
     return DArray(_apply_sharding(phys, dst), dst)
+
+
+def classify_transition(src: DArraySpec, dst: DArraySpec) -> str:
+    """Which tier of ``redistribute()``'s dispatch serves src -> dst,
+    WITHOUT executing it: ``trivial`` (respec) | ``kernel`` (single-hop
+    per-shard) | ``planned`` (multi-hop planner) | ``cross_mesh_plain``
+    (strip / device_put / dress) | ``fallback`` (pack∘unpack, may
+    materialize).  Kept NEXT to the dispatch above so the two cannot
+    drift — scripts/redistribute_bench.py reports this label per pair."""
+    from .redistribute_plan import plan_redistribute
+    from .transfer import (
+        interleaved_transition_fn,
+        ragged_transition_fn,
+        transition_fn,
+    )
+
+    def plain(s: DArraySpec) -> bool:
+        return not (
+            s.has_partial() or s.has_ragged() or s.layout().interleaves or s.layout().any_padded
+        )
+
+    if dst == src or (src.mesh == dst.mesh and plain(src) and plain(dst)):
+        return "trivial"
+    if transition_fn(src, dst) is not None:
+        return "kernel"
+    if (src.has_ragged() or dst.has_ragged()) and ragged_transition_fn(src, dst) is not None:
+        return "kernel"
+    if (src.layout().interleaves or dst.layout().interleaves) and (
+        interleaved_transition_fn(src, dst) is not None
+    ):
+        return "kernel"
+    if plan_redistribute(src, dst) is not None:
+        return "planned"
+    if src.mesh != dst.mesh:
+        sp, dp = _plain_placements(src), _plain_placements(dst)
+        if sp is not None and dp is not None:
+            mid = DArraySpec(src.mesh, sp, src.meta)
+            dmid = DArraySpec(dst.mesh, dp, dst.meta)
+            if all(
+                not (s.layout().any_padded or s.layout().interleaves or s.has_partial())
+                for s in (mid, dmid)
+            ):
+                return "cross_mesh_plain"
+    return "fallback"
 
 
 def _plain_placements(spec: DArraySpec):
@@ -145,8 +202,11 @@ def _warn_fallback(src: DArraySpec, dst: DArraySpec) -> None:
     import os
     import warnings
 
+    from . import telemetry as _tel
     from .debug import DebugLogger
+    from .redistribute_plan import decline_reason
 
+    _tel.count("redistribute.fallbacks")
     itemsize = jax.numpy.dtype(src.dtype).itemsize
     logical = itemsize
     for s in src.shape:
@@ -158,7 +218,8 @@ def _warn_fallback(src: DArraySpec, dst: DArraySpec) -> None:
         f"redistribute fallback for {src.placements} -> {dst.placements} "
         f"(mesh {src.mesh.mesh_dim_names}{'->' + str(dst.mesh.mesh_dim_names) if dst.mesh != src.mesh else ''}) "
         f"may materialize the LOGICAL tensor: ~{logical / 2**20:.1f} MiB vs "
-        f"~{shard / 2**20:.1f} MiB per-shard"
+        f"~{shard / 2**20:.1f} MiB per-shard; multi-hop planner declined: "
+        f"{decline_reason(src, dst)}"
     )
     if os.environ.get("VESCALE_STRICT_REDISTRIBUTE", "0").lower() not in ("", "0", "false"):
         raise RuntimeError(msg + " (VESCALE_STRICT_REDISTRIBUTE=1)")

@@ -1,0 +1,524 @@
+"""Multi-hop redistribution planner — kill the logical-materializing fallback.
+
+``redistribute()`` covers single-hop placement transitions with per-shard
+kernels (transfer.py); composite transitions — axis-swap cycles,
+Partial/reshard combinations, interleave changes differing on several mesh
+dims, cross-mesh moves — used to drop to the pack∘unpack fallback
+(redistribute.py) that can materialize the full logical tensor on every
+rank.  This module decomposes such a transition into a short sequence of
+per-shard primitive hops instead, the approach of "Memory-efficient array
+redistribution through portable collective communication" (arXiv:2112.01075);
+the cost model choosing among candidate sequences follows "On Optimizing the
+Communication of Model Parallelism" (arXiv:2211.05322).
+
+Search: bounded Dijkstra (default ≤3 hops, ``VESCALE_REDISTRIBUTE_MAX_HOPS``)
+over a placement lattice spanned per mesh dim by
+``placements.transition_candidates`` — the endpoints, plain-Shard
+relaxations of interleaves, and Replicate.  Edges are exactly the moves the
+per-shard engine already implements:
+
+  dense        transfer.transition_fn      (_plan_ops feasibility, no trace)
+  ragged       transfer.ragged_transition_fn   (all-gather-v / all-to-all-v)
+  interleaved  transfer.interleaved_transition_fn  (piece-exchange ppermute)
+  reshard      plain unpadded same-mesh respec (GSPMD device-to-device)
+  device_put   the cross-mesh bridge between plain unpadded specs
+
+Memory contract: every INTERMEDIATE spec's per-shard bytes must stay within
+``VESCALE_REDISTRIBUTE_MEM_FACTOR`` (default 4) × the larger endpoint shard —
+a plan through full replication is rejected unless an endpoint is itself
+logical-size.  Cost: per-hop bytes moved × a per-byte collective weight
+(all-to-all < reduce-scatter < all-gather on a torus) + a flat latency term
+so equal-byte plans prefer fewer hops.
+
+Plans (and declines, with their reason) are memoized per
+``(src_spec, dst_spec)`` in an LRU cache holding the already-jitted hop fns:
+a repeated boundary transition pays zero re-planning and zero retracing.
+Telemetry (when active): counters ``redistribute.plan_hits`` /
+``plan_misses`` / ``hops``, gauge+counter ``redistribute.bytes_moved`` —
+fed from ``plan_comm_summary``, the same accounting
+``debug.comm_mode.CommDebugMode.attribute_plan`` reads, so the two views
+agree by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+from .placements import transition_candidates
+from .spec import DArraySpec
+
+__all__ = [
+    "PlanHop",
+    "RedistributePlan",
+    "plan_redistribute",
+    "decline_reason",
+    "plan_comm_summary",
+    "can_redistribute_per_shard",
+    "clear_plan_cache",
+    "plan_cache_stats",
+]
+
+# per-byte cost weights on a torus: all-to-all keeps each link at 1/n of the
+# payload, reduce-scatter streams the ring once, all-gather delivers (n-1)/n
+# of the OUTPUT to every device, all-reduce ~ reduce-scatter + all-gather.
+# "reshard" (GSPMD-chosen) and the cross-mesh device_put sit between: they
+# move at most one destination shard per device but the compiler/runtime
+# picks the pattern, so they are costed conservatively.
+_WEIGHTS = {
+    "all_to_all": 1.0,
+    "collective_permute": 1.0,
+    "reduce_scatter": 2.0,
+    "all_gather": 4.0,
+    "all_reduce": 6.0,
+    "reshard": 2.0,
+    "device_put": 2.0,
+}
+# flat per-hop latency term (in cost units of bytes): at equal bytes moved,
+# fewer hops win — each hop is a separate dispatch + collective launch
+_HOP_LATENCY = 64 * 1024
+
+
+def _mem_factor() -> float:
+    return float(os.environ.get("VESCALE_REDISTRIBUTE_MEM_FACTOR", "4"))
+
+
+def _max_hops() -> int:
+    return int(os.environ.get("VESCALE_REDISTRIBUTE_MAX_HOPS", "3"))
+
+
+@dataclasses.dataclass
+class PlanHop:
+    """One primitive per-shard move of a multi-hop plan."""
+
+    kind: str  # "dense" | "ragged" | "interleaved" | "reshard" | "device_put"
+    src: DArraySpec
+    dst: DArraySpec
+    fn: object  # physical(src) -> physical(dst); None for reshard/device_put
+    collectives: Dict[str, int]  # expected collective kinds (static view)
+    bytes_moved: int  # per-device bytes on the wire (cost-model estimate)
+    cost: float
+
+    def apply(self, x):
+        if self.kind == "reshard":
+            from .darray import _apply_sharding
+
+            return _apply_sharding(x, self.dst)
+        if self.kind == "device_put":
+            return jax.device_put(x, self.dst.named_sharding())
+        return self.fn(x)
+
+
+@dataclasses.dataclass
+class RedistributePlan:
+    src: DArraySpec
+    dst: DArraySpec
+    hops: Tuple[PlanHop, ...]
+
+    @property
+    def bytes_moved(self) -> int:
+        return sum(h.bytes_moved for h in self.hops)
+
+    @property
+    def total_cost(self) -> float:
+        return sum(h.cost for h in self.hops)
+
+    def execute(self, physical):
+        """Run the hop chain on a physical(src) array; feeds the telemetry
+        plan counters/gauge from the SAME summary comm_mode attribution
+        reads (plan_comm_summary) so the two views cannot diverge."""
+        from . import telemetry as _tel
+
+        x = physical
+        for hop in self.hops:
+            x = hop.apply(x)
+        if _tel.is_active():
+            summary = plan_comm_summary(self)
+            _tel.count("redistribute.hops", len(self.hops))
+            _tel.count("redistribute.bytes_moved_total", summary["bytes_moved"])
+            _tel.set_gauge("redistribute.bytes_moved", summary["bytes_moved"])
+        return x
+
+
+def plan_comm_summary(plan: RedistributePlan) -> Dict:
+    """Per-hop collective/bytes attribution of a plan — the single source
+    both the telemetry bytes-moved gauge (RedistributePlan.execute) and
+    CommDebugMode.attribute_plan read."""
+    hops = []
+    collectives: Dict[str, int] = {}
+    for i, h in enumerate(plan.hops):
+        for k, v in h.collectives.items():
+            collectives[k] = collectives.get(k, 0) + v
+        hops.append(
+            {
+                "hop": i,
+                "kind": h.kind,
+                "src": [str(p) for p in h.src.placements],
+                "dst": [str(p) for p in h.dst.placements],
+                "collectives": dict(h.collectives),
+                "bytes_moved": h.bytes_moved,
+            }
+        )
+    return {
+        "hops": hops,
+        "n_hops": len(hops),
+        "bytes_moved": sum(h.bytes_moved for h in plan.hops),
+        "collectives": collectives,
+    }
+
+
+# ------------------------------------------------------------ edge builders
+def _dense_edge(src: DArraySpec, dst: DArraySpec, build: bool) -> Optional[PlanHop]:
+    from .transfer import _plan_ops, transition_fn
+
+    ops = _plan_ops(src, dst)
+    if ops is None:
+        return None
+    colls: Dict[str, int] = {}
+    bytes_m = 0
+    cost = 0.0
+    sb, db = src.per_shard_bytes(), dst.per_shard_bytes()
+    for op in ops:
+        kind, i = op[0], op[1]
+        n = src.mesh.shape[i]
+        f = (n - 1) / max(1, n)
+        if kind == "reduce":
+            b, c = 2 * f * max(sb, db), "all_reduce"
+        elif kind == "reduce_scatter":
+            b, c = f * sb, "reduce_scatter"
+        elif kind == "gather":
+            b, c = f * db, "all_gather"
+        elif kind == "move":
+            b, c = f * max(sb, db), "all_to_all"
+        else:  # slice / seed: local index math, no wire traffic
+            continue
+        colls[c] = colls.get(c, 0) + 1
+        bytes_m += int(b)
+        cost += _WEIGHTS[c] * b
+    fn = transition_fn(src, dst) if build else None
+    return PlanHop("dense", src, dst, fn, colls, bytes_m, cost + _HOP_LATENCY)
+
+
+def _ragged_edge(src: DArraySpec, dst: DArraySpec, build: bool) -> Optional[PlanHop]:
+    if not (src.has_ragged() or dst.has_ragged()):
+        return None
+    from .transfer import ragged_transition_fn
+
+    fn = ragged_transition_fn(src, dst)  # lru-cached; construction, no trace
+    if fn is None:
+        return None
+    sb, db = src.per_shard_bytes(), dst.per_shard_bytes()
+    if src.has_ragged() and dst.is_replicated():
+        colls, b, w = {"all_gather": 1}, db, _WEIGHTS["all_gather"]
+    elif src.is_replicated() and dst.has_ragged():
+        colls, b, w = {}, 0, 0.0  # slice-v: local, no comm
+    else:  # all-to-all-v as ppermute rounds
+        colls, b, w = {"collective_permute": 1}, max(sb, db), _WEIGHTS["all_to_all"]
+    return PlanHop(
+        "ragged", src, dst, fn if build else None, colls, int(b), w * b + _HOP_LATENCY
+    )
+
+
+def _interleaved_edge(src: DArraySpec, dst: DArraySpec, build: bool) -> Optional[PlanHop]:
+    if not (src.layout().interleaves or dst.layout().interleaves):
+        return None
+    from .transfer import interleaved_transition_fn
+
+    fn = interleaved_transition_fn(src, dst)
+    if fn is None:
+        return None
+    b = max(src.per_shard_bytes(), dst.per_shard_bytes())
+    return PlanHop(
+        "interleaved",
+        src,
+        dst,
+        fn if build else None,
+        {"collective_permute": 1},
+        int(b),
+        _WEIGHTS["all_to_all"] * b + _HOP_LATENCY,
+    )
+
+
+def _reshard_edge(src: DArraySpec, dst: DArraySpec) -> Optional[PlanHop]:
+    """Plain unpadded same-mesh respec: physical==logical on both sides, so
+    the runtime/GSPMD reshard is itself per-shard (the `trivial` path of
+    redistribute.py).  This is the edge that reaches nested-Shard endpoints
+    no explicit kernel produces."""
+    if src.mesh != dst.mesh:
+        return None
+    for s in (src, dst):
+        if (
+            s.has_partial()
+            or s.has_ragged()
+            or s.layout().interleaves
+            or s.layout().any_padded
+        ):
+            return None
+    b = max(src.per_shard_bytes(), dst.per_shard_bytes())
+    return PlanHop(
+        "reshard", src, dst, None, {"reshard": 1}, int(b), _WEIGHTS["reshard"] * b + _HOP_LATENCY
+    )
+
+
+def _edge(src: DArraySpec, dst: DArraySpec, build: bool = False) -> Optional[PlanHop]:
+    """The cheapest feasible primitive hop src -> dst, or None."""
+    return (
+        _dense_edge(src, dst, build)
+        or _ragged_edge(src, dst, build)
+        or _interleaved_edge(src, dst, build)
+        or _reshard_edge(src, dst)
+    )
+
+
+# ------------------------------------------------------------------ search
+def _candidate_specs(src: DArraySpec, dst: DArraySpec) -> List[DArraySpec]:
+    per_dim = [
+        transition_candidates(sp, dp)
+        for sp, dp in zip(src.placements, dst.placements)
+    ]
+    out: List[DArraySpec] = []
+    for combo in itertools.product(*per_dim):
+        spec = DArraySpec(src.mesh, combo, src.meta)
+        try:
+            spec.layout()  # composition validity (ragged/interleave rules)
+        except ValueError:
+            continue
+        out.append(spec)
+    return out
+
+
+def _search_same_mesh(
+    src: DArraySpec, dst: DArraySpec
+) -> Tuple[Optional[List[PlanHop]], str]:
+    """Bounded Dijkstra src -> dst over the candidate lattice.  Returns
+    (hops, "") or (None, decline reason)."""
+    nodes = _candidate_specs(src, dst)
+    if dst not in nodes:
+        nodes.append(dst)
+    budget = _mem_factor() * max(src.per_shard_bytes(), dst.per_shard_bytes())
+    node_bytes = {n: n.per_shard_bytes() for n in nodes}  # once, not per pop
+    max_hops = _max_hops()
+    over_budget = False
+
+    # best is keyed by (spec, hop count): a cheap-but-deep route must not
+    # shadow a costlier shallow one that still has hop budget to reach dst
+    best: Dict[Tuple[DArraySpec, int], float] = {(src, 0): 0.0}
+    tie = itertools.count()
+    heap: List[Tuple[float, int, int, DArraySpec, List[PlanHop]]] = [
+        (0.0, 0, next(tie), src, [])
+    ]
+    edge_cache: Dict[Tuple[DArraySpec, DArraySpec], Optional[PlanHop]] = {}
+    while heap:
+        cost, hops, _, spec, path = heapq.heappop(heap)
+        if spec == dst:
+            return path, ""
+        if hops >= max_hops or cost > best.get((spec, hops), float("inf")):
+            continue
+        for nxt in nodes:
+            if nxt == spec:
+                continue
+            if nxt != dst and node_bytes[nxt] > budget:
+                over_budget = True
+                continue
+            key = (spec, nxt)
+            if key not in edge_cache:
+                edge_cache[key] = _edge(spec, nxt)
+            e = edge_cache[key]
+            if e is None:
+                continue
+            c = cost + e.cost
+            if c < min(
+                best.get((nxt, h), float("inf")) for h in range(hops + 2)
+            ):
+                best[(nxt, hops + 1)] = c
+                heapq.heappush(heap, (c, hops + 1, next(tie), nxt, path + [e]))
+    if over_budget:
+        return None, (
+            "every candidate path needs an intermediate above the per-shard "
+            f"memory budget ({_mem_factor():g}x the larger endpoint shard; "
+            "raise VESCALE_REDISTRIBUTE_MEM_FACTOR to trade memory for locality)"
+        )
+    return None, f"no per-shard hop sequence within {max_hops} hops over the candidate lattice"
+
+
+def _materialize(hops: List[PlanHop]) -> Tuple[PlanHop, ...]:
+    """Re-fetch the (lru-cached) jitted kernels for the winning path only —
+    losing search edges never build a fn."""
+    out = []
+    for h in hops:
+        if h.kind in ("reshard", "device_put"):
+            out.append(h)
+            continue
+        built = _edge(h.src, h.dst, build=True)
+        out.append(built)
+    return tuple(out)
+
+
+def _unpadded_bridge(spec: DArraySpec) -> Optional[DArraySpec]:
+    """A plain (no partial/interleave/ragged) UNPADDED spec reachable from
+    ``spec`` on its own mesh, suitable as a cross-mesh device_put endpoint
+    (physical==logical shard-wise).  Starts from the plain form; Shard dims
+    whose extents pad are relaxed to Replicate — a padded physical layout
+    must not be device_put into a differently-padded one."""
+    from .placements import Replicate as R
+    from .redistribute import _plain_placements
+
+    base = _plain_placements(spec)
+    if base is None:
+        return None
+    cand = DArraySpec(spec.mesh, base, spec.meta)
+    if not cand.layout().any_padded:
+        return cand
+    out = list(base)
+    for ax in cand.layout().body_axes:
+        if ax.is_padded:
+            for i in ax.mesh_dims:
+                out[i] = R()
+    cand = DArraySpec(spec.mesh, tuple(out), spec.meta)
+    return None if cand.layout().any_padded else cand
+
+
+def _plan_cross_mesh(
+    src: DArraySpec, dst: DArraySpec
+) -> Tuple[Optional[RedistributePlan], str]:
+    """Bridge meshes through plain unpadded specs: plan src -> plain on the
+    source mesh, device_put the shards across, plan plain -> dst on the
+    destination mesh (the reference CrossMeshRedistribute round-trips the
+    LOGICAL value; this path never does)."""
+    mid = _unpadded_bridge(src)
+    dmid = _unpadded_bridge(dst)
+    if mid is None or dmid is None:
+        return None, "cross-mesh: a side has no plain unpadded per-shard bridge form"
+    budget = _mem_factor() * max(src.per_shard_bytes(), dst.per_shard_bytes())
+    for s in (mid, dmid):
+        if s not in (src, dst) and s.per_shard_bytes() > budget:
+            return None, (
+                "cross-mesh: the unpadded bridge spec exceeds the per-shard "
+                f"memory budget ({_mem_factor():g}x the larger endpoint shard; "
+                "raise VESCALE_REDISTRIBUTE_MEM_FACTOR to trade memory for locality)"
+            )
+    hops: List[PlanHop] = []
+    if mid != src:
+        sub, reason = _search_same_mesh(src, mid)
+        if sub is None:
+            return None, f"cross-mesh: source-side strip failed — {reason}"
+        hops.extend(sub)
+    hops.append(
+        PlanHop(
+            "device_put",
+            mid,
+            dmid,
+            None,
+            {"device_put": 1},
+            int(dmid.per_shard_bytes()),
+            _WEIGHTS["device_put"] * dmid.per_shard_bytes() + _HOP_LATENCY,
+        )
+    )
+    if dmid != dst:
+        sub, reason = _search_same_mesh(dmid, dst)
+        if sub is None:
+            return None, f"cross-mesh: destination-side dress failed — {reason}"
+        hops.extend(sub)
+    return RedistributePlan(src, dst, _materialize(hops)), ""
+
+
+# ---------------------------------------------------------------- LRU cache
+class _LRU:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            if key not in self._d:
+                return None
+            self._d.move_to_end(key)
+            return self._d[key]
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.maxsize:
+                self._d.popitem(last=False)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._d
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+
+_PLANS = _LRU(512)
+_DECLINES = _LRU(512)  # (src, dst) -> reason string
+
+
+def plan_redistribute(src: DArraySpec, dst: DArraySpec) -> Optional[RedistributePlan]:
+    """A memoized multi-hop plan for src -> dst, or None (reason retrievable
+    via ``decline_reason``).  Consulted by ``redistribute()`` only after the
+    single-hop kernels decline."""
+    from . import telemetry as _tel
+
+    # the knobs are part of the key: raising VESCALE_REDISTRIBUTE_MEM_FACTOR
+    # after a budget decline (as the fallback warning instructs) must
+    # re-search, not re-serve the cached decline
+    key = (src, dst, _mem_factor(), _max_hops())
+    plan = _PLANS.get(key)
+    if plan is not None:
+        _tel.count("redistribute.plan_hits")
+        return plan
+    reason = _DECLINES.get(key)
+    if reason is not None:
+        return None
+    _tel.count("redistribute.plan_misses")
+    if src.mesh != dst.mesh:
+        plan, reason = _plan_cross_mesh(src, dst)
+    else:
+        hops, reason = _search_same_mesh(src, dst)
+        plan = RedistributePlan(src, dst, _materialize(hops)) if hops is not None else None
+    if plan is None:
+        _DECLINES.put(key, reason or "unknown")
+        return None
+    _PLANS.put(key, plan)
+    return plan
+
+
+def decline_reason(src: DArraySpec, dst: DArraySpec) -> str:
+    """Why the planner declined (src, dst) — for the fallback warning."""
+    reason = _DECLINES.get((src, dst, _mem_factor(), _max_hops()))
+    return reason if reason is not None else "planner was not consulted for this pair"
+
+
+def can_redistribute_per_shard(src: DArraySpec, dst: DArraySpec) -> bool:
+    """True when ``redistribute(src -> dst)`` stays on per-shard paths (the
+    trivial respec, a single-hop kernel, or a plan) — i.e. it will NOT hit
+    the logical-materializing fallback.  Used by the checkpoint loader to
+    decide whether a planner-backed per-shard load is available."""
+    if src == dst or _reshard_edge(src, dst) is not None:
+        return True
+    if _edge(src, dst) is not None:
+        return True
+    return plan_redistribute(src, dst) is not None
+
+
+def clear_plan_cache() -> None:
+    _PLANS.clear()
+    _DECLINES.clear()
+
+
+def plan_cache_stats() -> Dict[str, int]:
+    return {"plans": len(_PLANS), "declines": len(_DECLINES)}

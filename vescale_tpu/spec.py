@@ -172,6 +172,19 @@ class DArraySpec:
     def with_placements(self, placements) -> "DArraySpec":
         return DArraySpec(self.mesh, placements, self.meta)
 
+    def logical_bytes(self) -> int:
+        """Bytes of the full logical tensor."""
+        return _prod(self.meta.shape) * jnp.dtype(self.meta.dtype).itemsize
+
+    def per_shard_bytes(self) -> int:
+        """Per-device bytes of the PHYSICAL layout — the quantity the
+        redistribute planner's memory budget bounds (redistribute_plan.py):
+        an intermediate spec whose shards are logical-size is exactly the
+        materialization the planner exists to avoid."""
+        lay = self.layout()
+        shard = self.named_sharding().shard_shape(lay.physical_shape)
+        return _prod(shard) * jnp.dtype(self.meta.dtype).itemsize
+
     def __eq__(self, other) -> bool:
         return (
             isinstance(other, DArraySpec)

@@ -22,6 +22,15 @@ from .dmodule.api import DModule
 
 __all__ = ["make_train_step", "make_eval_step"]
 
+# double-increment guard (ADVICE): with auto_inc_step (default), a loop that
+# ALSO advances the ndtimeline counter manually (inc_step() /
+# flush(next_iteration=True)) per step silently double-counts the global
+# step.  SHARED across every make_train_step fn: any auto-inc step records
+# the counter value it produced here, so a second auto-inc fn (train + eval
+# loops sharing one manager) is recognized as legitimate — only a counter
+# move no auto-inc step made triggers the one-time warning.
+_AUTO_STEP_GUARD: Dict[str, Any] = {"mgr": None, "step": None, "warned": False}
+
 
 def make_train_step(
     dmodel: DModule,
@@ -260,7 +269,25 @@ def make_train_step(
         with _nd.ndtimeit(TRAIN_STEP):
             out = jitted(*args, **kwargs)
         if auto_inc_step and _nd.is_active():
-            _nd.get_manager().inc_step()
+            mgr = _nd.get_manager()
+            g = _AUTO_STEP_GUARD
+            if g["mgr"] is not mgr:  # manager re-init: restart tracking
+                g["mgr"], g["step"] = mgr, None
+            if not g["warned"] and g["step"] is not None and mgr.step > g["step"]:
+                import warnings
+
+                g["warned"] = True
+                warnings.warn(
+                    "make_train_step(auto_inc_step=True) advances the "
+                    "ndtimeline step counter itself, but it was ALSO advanced "
+                    "externally (manual inc_step() or flush(next_iteration="
+                    "True)) within one training step — steps are being "
+                    "double-counted.  Pass auto_inc_step=False to keep manual "
+                    "control, or drop the manual increment.",
+                    stacklevel=2,
+                )
+            mgr.inc_step()
+            g["step"] = mgr.step
         if with_metrics:
             # the telemetry scalars ride as a trailing pytree; strip them
             # unconditionally so the public return shape never depends on

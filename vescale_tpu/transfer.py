@@ -494,10 +494,15 @@ def interleaved_transition_fn(src: DArraySpec, dst: DArraySpec):
     interleaved view rules, legacy/vescale/dtensor/ops/vescale_view_ops.py:
     11-14; redistribute.py:223), or None when the pair needs the fallback.
 
-    Scope: same mesh/shape, no partial/ragged, exactly ONE mesh dim differs,
-    and on that dim both sides place the SAME tensor dim ``d`` via
+    Scope: same mesh/shape, no ragged, exactly ONE mesh dim differs, and on
+    that dim both sides place the SAME tensor dim ``d`` via
     Shard(d) / InterleavedShard(d, k) / Replicate with at least one
-    interleave and exact divisibility.  Covers the merged-QKV reshards —
+    interleave and exact divisibility.  UNCHANGED Partial placements on
+    other mesh dims ride along: piece-exchange is pure data movement along
+    mesh dim ``i`` — linear over the partial contributions, which never mix
+    across their own mesh dim — so the result stays a valid partial value
+    (a CHANGED partial dim fails the one-differing-dim/type guards below).
+    Covers the merged-QKV reshards —
     IS(d,k) <-> Shard(d), IS(d,k) -> IS(d,k'), IS -> Replicate and back —
     whose r4 fallback could materialize the logical tensor (a 70B
     interleaved-QKV reshard would OOM a 96 GB chip).
@@ -514,7 +519,7 @@ def interleaved_transition_fn(src: DArraySpec, dst: DArraySpec):
 
     if src.mesh != dst.mesh or src.shape != dst.shape:
         return None
-    if src.has_partial() or dst.has_partial() or src.has_ragged() or dst.has_ragged():
+    if src.has_ragged() or dst.has_ragged():
         return None
     if not (src.layout().interleaves or dst.layout().interleaves):
         return None
@@ -579,9 +584,13 @@ def interleaved_transition_fn(src: DArraySpec, dst: DArraySpec):
     if not plans:
         return None
 
+    # _axis_span counts BODY axes; partial dims prepend lead axes (local
+    # extent 1 under shard_map) that shift dim d's physical position
+    lead = len(src.layout().partial_mesh_dims)
     pos_s, span_s = _axis_span(src, d)
     pos_d, span_d = _axis_span(dst, d)
-    dst_phys = dst.layout().physical_shape
+    pos_s += lead
+    pos_d += lead
     ax_name = mesh.dim_name(i)
     perms = {
         delta: [(p, (p + delta) % n) for p in range(n)]
