@@ -242,6 +242,89 @@ def bench_longctx():
     )
 
 
+def bench_memtrack():
+    """Memory-tracking overhead rung (VESCALE_BENCH=memtrack): the SAME
+    compiled step timed under telemetry without and with memtrack, so the
+    reported delta is the per-step cost of the memory layer alone (census +
+    device gauges + history ring), not the grad-norm scalars or the JSONL
+    stream.  The number production runs consult before leaving memtrack on."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from vescale_tpu import telemetry
+    from vescale_tpu.dmodule import parallelize_module
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.models.llama import Llama, LlamaConfig, llama_plan
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
+    from vescale_tpu.parallel.optimizer import DistributedOptimizer
+    from vescale_tpu.telemetry import memtrack
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    B, T = (4, 1024) if on_tpu else (2, 64)
+    cfg = LlamaConfig(
+        vocab_size=2048 if on_tpu else 128,
+        hidden_size=256 if on_tpu else 32,
+        intermediate_size=512 if on_tpu else 64,
+        num_hidden_layers=4 if on_tpu else 2,
+        num_attention_heads=4 if on_tpu else 2,
+        num_key_value_heads=4 if on_tpu else 2,
+        max_position_embeddings=T,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    mesh = DeviceMesh(("dp", "tp"), (1, 1), devices=devices[:1])
+    dm = parallelize_module(Llama(cfg), mesh, llama_plan(mesh, sequence_parallel=False))
+    params = dm.init(jax.random.key(0), jnp.ones((2, T), jnp.int32))["params"]
+    dopt = DistributedOptimizer(optax.adamw(1e-3))
+
+    from vescale_tpu.train import make_train_step
+
+    out_dir = tempfile.mkdtemp(prefix="bench_memtrack_")
+    # build ONCE under telemetry so both loops run the identical program
+    telemetry.init(out_dir=out_dir, memtrack=False)
+    opt_state = dopt.init(params)
+    step = make_train_step(
+        dm, dopt, lambda lg, b: cross_entropy_loss(lg, b["target"]), donate=False
+    )
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T + 1)), jnp.int32)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+
+    def timed_loop(iters):
+        p, s = params, opt_state
+        for _ in range(3):  # warmup/compile
+            p, s, loss = step(p, s, batch)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, s, loss = step(p, s, batch)
+        float(loss)
+        return (time.perf_counter() - t0) / iters
+
+    iters = 20 if on_tpu else 5
+    base = timed_loop(iters)  # telemetry on, memtrack off
+    telemetry.shutdown()
+    telemetry.init(out_dir=out_dir)  # memtrack on (default)
+    memtrack.tag_tree(params, "params")
+    tracked = timed_loop(iters)
+    tracker = memtrack.get_tracker()
+    live = tracker.history[-1]["live_arrays"] if tracker.history else 0
+    telemetry.shutdown()
+    overhead = tracked - base
+    print(json.dumps({
+        "metric": "memtrack_overhead_ms_per_step",
+        "value": round(overhead * 1e3, 4),
+        "unit": "ms",
+        "overhead_frac": round(overhead / base, 4) if base > 0 else None,
+        "step_ms_base": round(base * 1e3, 3),
+        "step_ms_memtrack": round(tracked * 1e3, 3),
+        "live_arrays": live,
+    }))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -354,6 +437,8 @@ def _dispatch():
         bench_moe()
     elif which == "longctx":
         bench_longctx()
+    elif which == "memtrack":
+        bench_memtrack()
     elif which == "redistribute":
         # multi-hop planner battery (VESCALE_BENCH=redistribute): plan
         # length, bytes moved and retrace count per representative

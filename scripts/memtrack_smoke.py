@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Memory-tracking smoke test — the acceptance contract of the memory
+section of docs/observability.md.
+
+Runs a tiny CPU train loop with ``telemetry.init()`` (memtrack on) and
+validates the whole memory-observability path end to end:
+
+  1. Tagged live-array census: nonzero ``params`` and ``optimizer_state``
+     buckets after real steps (factory/init hooks + step-output re-tagging).
+  2. Per-step memory records in ``steps.jsonl`` and ``mem_*`` gauges in the
+     Prometheus dump / dashboard memory section.
+  3. ``dump_now()``: a flight-recorder JSON bundle with census, device
+     memory (host-RSS fallback on CPU), history ring, registry snapshot and
+     the last step report.
+  4. Simulated OOM: a raised RESOURCE_EXHAUSTED inside a
+     ``flight_recorder``-wrapped step triggers the same dump path and still
+     propagates the exception.
+  5. The gating contract: after ``shutdown()`` the tag hooks are the no-op
+     references again and darray factories register nothing.
+
+Exit 0 on success, 1 with a FAIL line per broken check.  Wired into tier-1
+via tests/test_memtrack.py.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def check(failures, ok: bool, label: str) -> None:
+    print(("PASS" if ok else "FAIL") + f"  {label}")
+    if not ok:
+        failures.append(label)
+
+
+def build_step():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from vescale_tpu.dmodule import parallelize_module
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.models.llama import Llama, LlamaConfig, llama_plan
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
+    from vescale_tpu.parallel.optimizer import DistributedOptimizer
+    from vescale_tpu.train import make_train_step
+
+    B, T = 2, 16
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=T, dtype=jnp.float32,
+    )
+    mesh = DeviceMesh(("dp", "tp"), (1, 1), devices=jax.devices()[:1])
+    dm = parallelize_module(Llama(cfg), mesh, llama_plan(mesh, sequence_parallel=False))
+    params = dm.init(jax.random.key(0), jnp.ones((2, T), jnp.int32))["params"]
+    dopt = DistributedOptimizer(optax.adamw(1e-3))
+    opt_state = dopt.init(params)  # tagged optimizer_state by the init hook
+    step = make_train_step(
+        dm, dopt, lambda lg, b: cross_entropy_loss(lg, b["target"]), donate=False,
+    )
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T + 1)), jnp.int32)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+    return step, params, opt_state, batch
+
+
+def main() -> int:
+    failures: list = []
+    from vescale_tpu import telemetry
+    from vescale_tpu.telemetry import memtrack
+    from vescale_tpu.telemetry.exporters import parse_prometheus_text
+
+    out_dir = tempfile.mkdtemp(prefix="memtrack_smoke_")
+
+    # ------------------------------------------------- instrumented loop
+    telemetry.init(out_dir=out_dir)
+    check(failures, memtrack.is_active(), "memtrack activated by telemetry.init")
+    check(failures, memtrack.tag_array is not memtrack._noop_tag_array,
+          "live tag hook bound")
+
+    step, params, opt_state, batch = build_step()
+    memtrack.tag_tree(params, "params")  # initial params (flax init path)
+    step = telemetry.flight_recorder(step)
+    n_steps = 3
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+
+    # (a) tagged census: the acceptance buckets
+    census = memtrack.get_tracker().census()
+    tags = census["tags"]
+    check(failures, tags.get("params", {}).get("bytes", 0) > 0,
+          "census has nonzero params bucket")
+    check(failures, tags.get("optimizer_state", {}).get("bytes", 0) > 0,
+          "census has nonzero optimizer_state bucket")
+    check(failures, census["live_arrays"] > 0 and census["top_arrays"],
+          "census lists live arrays and top offenders")
+
+    # (b) per-step memory records + exporter surfaces
+    report = telemetry.write_step_report("train_step", step, params, opt_state, batch)
+    prom = telemetry.prometheus_dump()
+    dash = telemetry.dashboard()
+    series = parse_prometheus_text(prom or "")
+    check(failures, any(k.startswith("mem_tag_params") for k in series),
+          "prometheus exports mem_tag_params_bytes")
+    check(failures, any(k.startswith("mem_device") or k == "mem_host_rss_bytes"
+                        for k in series),
+          "prometheus exports device/host memory gauges")
+    check(failures, bool(dash) and "memory:" in dash,
+          "dashboard renders a memory section")
+
+    # (c) on-demand flight record
+    bundle = telemetry.dump_now(reason="smoke")
+    check(failures, bundle is not None and "path" in bundle, "dump_now wrote a bundle")
+    for key in ("census", "device_memory", "history", "registry", "last_step_report"):
+        check(failures, bundle is not None and bundle.get(key) is not None,
+              f"bundle carries {key!r}")
+    check(failures,
+          bundle is not None
+          and bundle["last_step_report"].get("name") == "train_step",
+          "bundle embeds the last step report")
+
+    # (d) simulated OOM through the flight recorder
+    @telemetry.flight_recorder
+    def exploding_step(*a):
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to allocate 987654321 bytes."
+        )
+
+    raised = False
+    try:
+        exploding_step(params, opt_state, batch)
+    except RuntimeError as e:
+        raised = "RESOURCE_EXHAUSTED" in str(e)
+    check(failures, raised, "simulated OOM still propagates")
+    dumps = sorted(glob.glob(os.path.join(out_dir, "flight_record_*.json")))
+    check(failures, len(dumps) >= 2, "OOM wrote a second flight record")
+    if dumps:
+        oom = json.load(open(dumps[-1]))
+        check(failures, oom["reason"].startswith("oom:"), "OOM dump reason tagged")
+        check(failures, oom["census"]["tags"].get("params", {}).get("bytes", 0) > 0,
+              "OOM dump census still tagged")
+
+    telemetry.shutdown()
+
+    # (e) steps.jsonl memory records
+    records = [json.loads(l) for l in open(os.path.join(out_dir, "steps.jsonl"))]
+    check(failures, len(records) == n_steps, f"steps.jsonl has {n_steps} records")
+    check(failures, all("memory" in r for r in records),
+          "every step record carries a memory section")
+    check(failures, all("tags" in r["memory"] and "devices" in r["memory"]
+                        for r in records),
+          "memory section has tags + devices")
+
+    # ---------------------------------------------- dormant (gated) check
+    check(failures, memtrack.tag_array is memtrack._noop_tag_array,
+          "gate: tag hook restored to the no-op reference")
+    check(failures, memtrack.get_tracker() is None, "gate: no tracker after shutdown")
+    check(failures, telemetry.dump_now() is None, "gate: dump_now no-op while dormant")
+
+    import jax
+    from vescale_tpu import zeros
+    from vescale_tpu.mesh import DeviceMesh
+
+    mesh = DeviceMesh(("dp",), (1,), devices=jax.devices()[:1])
+    with memtrack.tagged("params"):
+        zeros((4, 4), device_mesh=mesh)  # hook must be a no-op now
+    check(failures, memtrack.get_tracker() is None,
+          "gate: dormant factory registered nothing")
+
+    if failures:
+        print(f"\nmemtrack smoke: {len(failures)} FAILED")
+        return 1
+    print(f"\nmemtrack smoke: all checks passed (artifacts in {out_dir})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -485,6 +485,8 @@ def load(
     from ..ndtimeline.api import ndtimeit
     from ..ndtimeline.predefined import CHECKPOINT_LOAD
 
+    from ..telemetry import memtrack as _memtrack
+
     t0 = time.perf_counter()
     with ndtimeit(CHECKPOINT_LOAD, tags={"path": path}):
         out = _load_impl(path, checkpoint_state, strict)
@@ -492,7 +494,10 @@ def load(
         _tel.count("checkpoint_loads_total")
         _tel.count("checkpoint_bytes_read_total", LAST_LOAD_STATS["bytes_read"])
         _tel.observe("checkpoint_load_seconds", time.perf_counter() - t0)
-    return out
+    # memory attribution: freshly loaded arrays are checkpoint buffers until
+    # the runtime claims them (the train-step wrapper re-tags params /
+    # optimizer state on the first step)
+    return _memtrack.tag_tree(out, "checkpoint_buffers")
 
 
 def _load_impl(path: str, checkpoint_state: Dict[str, Any], strict: bool) -> Dict[str, Any]:

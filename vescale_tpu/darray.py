@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from .mesh import DeviceMesh
+from .telemetry import memtrack as _memtrack
 from .placements import (
     InterleavedShard,
     Partial,
@@ -264,7 +265,10 @@ def distribute_tensor(tensor, mesh: DeviceMesh, placements=None) -> DArray:
         TensorMeta(tuple(tensor.shape), tensor.dtype),
     )
     phys = spec.pack(tensor)
-    return DArray(_apply_sharding(phys, spec), spec)
+    # memory-attribution hook: registers under the ambient memtrack.tagged()
+    # scope; the dormant binding is a no-op function reference (module-attr
+    # access on purpose — see telemetry/memtrack.py gating contract)
+    return _memtrack.tag_array(DArray(_apply_sharding(phys, spec), spec))
 
 
 def from_local(
@@ -305,7 +309,7 @@ def from_local(
         if spec.has_partial() or any(isinstance(p, (Shard, InterleavedShard)) for p in placements):
             locals_ = [np.asarray(single)] * device_mesh.size()
         else:
-            return DArray(_apply_sharding(single, spec), spec)
+            return _memtrack.tag_array(DArray(_apply_sharding(single, spec), spec))
 
     # infer logical global shape from locals
     if shape is None:
@@ -343,7 +347,7 @@ def from_local(
                 gshape = [total]
         shape = tuple(gshape)
     spec = DArraySpec(device_mesh, placements, TensorMeta(tuple(shape), jnp.asarray(locals_[0]).dtype))
-    return DArray(_assemble_physical(spec, locals_), spec)
+    return _memtrack.tag_array(DArray(_assemble_physical(spec, locals_), spec))
 
 
 def _assemble_physical(spec: DArraySpec, locals_) -> jax.Array:
@@ -440,7 +444,7 @@ def _factory(fill_fn, shape, mesh, placements, dtype):
     # patched CUDA philox for).  XLA partitions the generator under jit.
     logical = fill_fn(tuple(shape), jnp.dtype(dtype))
     phys = spec.pack(logical)
-    return DArray(_apply_sharding(phys, spec), spec)
+    return _memtrack.tag_array(DArray(_apply_sharding(phys, spec), spec))
 
 
 def zeros(*shape, device_mesh: DeviceMesh, placements=None, dtype=jnp.float32) -> DArray:
@@ -484,4 +488,4 @@ def arange(*args, device_mesh: DeviceMesh, placements=None, dtype=None) -> DArra
         normalize_placements(placements, device_mesh.ndim, 1),
         TensorMeta(tuple(logical.shape), logical.dtype),
     )
-    return DArray(_apply_sharding(spec.pack(logical), spec), spec)
+    return _memtrack.tag_array(DArray(_apply_sharding(spec.pack(logical), spec), spec))

@@ -96,6 +96,14 @@ class NDTimerManager:
     def inc_step(self, n: int = 1) -> None:
         self.step += n
 
+    def tail(self, n: int = 200) -> List[Span]:
+        """Last ``n`` buffered spans WITHOUT draining them — the flight
+        recorder's peek (an OOM dump must not steal spans from the flush a
+        surviving handler still expects)."""
+        with self._lock:
+            spans = list(self._spans)
+        return spans[-n:]
+
     # ----------------------------------------------------------- flush
     def flush(self, step_range=None) -> List[Span]:
         """Drain buffered spans to the handlers.  ``step_range=(lo, hi)``

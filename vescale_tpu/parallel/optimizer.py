@@ -166,6 +166,23 @@ def clip_grad_norm_fp32(grads, max_norm: float, norm_type: int = 2):
     return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads), total
 
 
+def _optimizer_step_span():
+    """ndtimeline OPTIMIZER_STEP span for EAGER optimizer steps only.
+
+    ``step`` is usually traced inside the jitted train step, where a host
+    span would bracket trace time once and then never fire — the in-jit
+    device work belongs to the XLA profiler.  Eager call sites (the pipe
+    engine's update loop, examples, debugging) get a real span."""
+    import contextlib
+
+    from ..ndtimeline.api import is_active, ndtimeit
+    from ..ndtimeline.predefined import OPTIMIZER_STEP
+
+    if is_active() and jax.core.trace_state_clean():
+        return ndtimeit(OPTIMIZER_STEP)
+    return contextlib.nullcontext()
+
+
 # ---------------------------------------------------------------- wrappers
 class BasicOptimizer:
     """DP-replicated optimizer wrapper (reference base_optimizer.py:116):
@@ -176,13 +193,16 @@ class BasicOptimizer:
         self.grad_clip = grad_clip
 
     def init(self, params):
-        return self.tx.init(params)
+        from ..telemetry import memtrack as _memtrack
+
+        return _memtrack.tag_tree(self.tx.init(params), "optimizer_state")
 
     def step(self, params, opt_state, grads):
-        if self.grad_clip is not None:
-            grads, _ = clip_grad_norm_fp32(grads, self.grad_clip)
-        updates, opt_state = self.tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state
+        with _optimizer_step_span():
+            if self.grad_clip is not None:
+                grads, _ = clip_grad_norm_fp32(grads, self.grad_clip)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
 
 
 class DistributedOptimizer:
@@ -262,6 +282,8 @@ class DistributedOptimizer:
 
     # ------------------------------------------------------------- state
     def init(self, params):
+        from ..telemetry import memtrack as _memtrack
+
         main = jax.tree_util.tree_map(lambda p: p.astype(self.main_param_dtype), params)
         if self.mesh is not None and self.param_pspecs is not None:
             main = _constrain_state(main, params, self.param_pspecs, self.mesh, self.dp_dims)
@@ -274,7 +296,9 @@ class DistributedOptimizer:
                 # overflowing at the floor) is observable instead of silent
                 "skip_count": jnp.asarray(0, jnp.int32),
             }
-        return state
+        # memory attribution: fp32 masters + moments are usually the single
+        # largest resident HBM bucket — the census must name them
+        return _memtrack.tag_tree(state, "optimizer_state")
 
     # ------------------------------------------------------- loss scaling
     def current_scale(self, opt_state):
@@ -293,6 +317,10 @@ class DistributedOptimizer:
         """copy grads -> fp32, unscale, clip, inner step on fp32 master
         shards, copy master -> model params (reference step/:1142-1223
         pipeline); overflow -> skip + scale backoff."""
+        with _optimizer_step_span():
+            return self._step_impl(params, opt_state, grads)
+
+    def _step_impl(self, params, opt_state, grads):
         inv = 1.0 / self.current_scale(opt_state)
         grads32 = jax.tree_util.tree_map(
             lambda g: g.astype(self.main_param_dtype) * inv.astype(self.main_param_dtype), grads

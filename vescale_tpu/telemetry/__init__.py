@@ -11,11 +11,17 @@ Three observability signals, one pipeline (docs/observability.md):
   3. **Exporters** (exporters.py): per-step JSONL stream, Prometheus text
      exposition, human-readable dashboard — plus a **straggler detector**
      (straggler.py) over the ndtimeline streamer's cross-rank spans.
+  4. **Memory tracking** (memtrack.py + memory_report.py): live HBM gauges
+     (host-RSS fallback), owner-tagged live-array census, leak detection,
+     AOT-budget drift, and the OOM **flight recorder** (forensic JSON dump
+     on RESOURCE_EXHAUSTED or via ``dump_now()``).
 
 Gating contract (same as ndtimeline): a run that never calls
-``telemetry.init()`` pays zero overhead — no registry, no locks, no files.
+``telemetry.init()`` pays zero overhead — no registry, no locks, no files,
+no tag registry (the memtrack hooks are no-op function references).
 """
 
+from . import memtrack
 from .api import (
     count,
     dashboard,
@@ -31,6 +37,8 @@ from .api import (
     write_step_report,
 )
 from .exporters import JsonlExporter, parse_prometheus_text, prometheus_text
+from .memory_report import compare_with_aot, device_memory_stats
+from .memtrack import dump_now, flight_recorder, tagged
 from .registry import Counter, Gauge, Histogram, MetricsRegistry
 from .step_report import build_step_report, read_step_report
 from .straggler import StragglerDetector
@@ -58,4 +66,10 @@ __all__ = [
     "build_step_report",
     "read_step_report",
     "StragglerDetector",
+    "memtrack",
+    "flight_recorder",
+    "dump_now",
+    "tagged",
+    "compare_with_aot",
+    "device_memory_stats",
 ]

@@ -25,6 +25,7 @@ import os
 import time
 from typing import Any, Callable, Dict, Optional
 
+from . import memtrack as _memtrack
 from .exporters import JsonlExporter, dashboard as _dashboard, prometheus_text
 from .registry import MetricsRegistry
 
@@ -60,6 +61,8 @@ class TelemetryState:
         self.registry = MetricsRegistry(default_window=window)
         self.step = 0
         self.jsonl: Optional[JsonlExporter] = None
+        self.memtrack = None  # set by init() when memory tracking is on
+        self.last_step_report: Optional[Dict] = None  # flight-recorder feed
         if jsonl and out_dir is not None:
             os.makedirs(out_dir, exist_ok=True)
             self.jsonl = JsonlExporter(os.path.join(out_dir, "steps.jsonl"))
@@ -73,23 +76,42 @@ def init(
     rank: int = 0,
     window: int = 1024,
     jsonl: bool = True,
+    memtrack: bool = True,
+    memtrack_interval: int = 1,
+    memtrack_history: int = 16,
+    memtrack_leak_steps: int = 5,
 ) -> TelemetryState:
     """Activate telemetry.  ``out_dir=None`` keeps everything in-memory
     (registry only — no JSONL stream, no report files).  Re-initializing
     while active closes the previous state's stream first (its registry is
-    discarded)."""
+    discarded).
+
+    ``memtrack`` (default on) also activates memory tracking (memtrack.py):
+    live HBM gauges + tagged live-array census sampled every
+    ``memtrack_interval`` steps, a ``memtrack_history``-deep sample ring for
+    the OOM flight recorder, and a leak warning after
+    ``memtrack_leak_steps`` consecutive steps of monotonic untagged
+    growth."""
     global _STATE
     if _STATE is not None:
         shutdown()
     _STATE = TelemetryState(out_dir, rank, window, jsonl)
+    if memtrack:
+        _STATE.memtrack = _memtrack.activate(
+            history=memtrack_history,
+            leak_steps=memtrack_leak_steps,
+            census_interval=memtrack_interval,
+        )
     return _STATE
 
 
 def shutdown() -> None:
-    """Deactivate and release the gate; flushes/closes the JSONL stream."""
+    """Deactivate and release the gate; flushes/closes the JSONL stream
+    and restores the memtrack no-op hooks."""
     global _STATE
     if _STATE is not None and _STATE.jsonl is not None:
         _STATE.jsonl.close()
+    _memtrack.deactivate()
     _STATE = None
 
 
@@ -137,8 +159,16 @@ def record_step(metrics: Dict[str, Any]) -> None:
             reg.gauge(gname).set(float(metrics[key]))
     if metrics.get("overflow"):
         reg.counter("train_overflow_steps_total").inc()
+    mem = None
+    if st.memtrack is not None:
+        # per-step memory sample: device gauges, tagged census, leak check
+        # (None on census-interval skip steps — the jsonl line just omits it)
+        mem = st.memtrack.on_step(st.step, reg)
     if st.jsonl is not None:
-        st.jsonl.emit({"step": st.step, "rank": st.rank, "ts": time.time(), **metrics})
+        rec = {"step": st.step, "rank": st.rank, "ts": time.time(), **metrics}
+        if mem is not None:
+            rec["memory"] = mem
+        st.jsonl.emit(rec)
 
 
 def observe(name: str, value: float) -> None:
@@ -157,22 +187,45 @@ def set_gauge(name: str, value: float) -> None:
 
 
 # ----------------------------------------------------------------- outputs
-def write_step_report(name: str, fn: Callable, *args, **kwargs) -> Optional[Dict]:
+def write_step_report(
+    name: str, fn: Callable, *args, aot_report=None, **kwargs
+) -> Optional[Dict]:
     """Build a compile-time step report (see step_report.py) and — when an
     ``out_dir`` is configured — persist it as ``<out_dir>/<name>_report.json``.
-    No-op while dormant."""
+    No-op while dormant.
+
+    ``aot_report``: path to (or loaded dict of) a matching
+    ``AOT_*_REPORT.json`` — the report gains an ``aot_drift`` section
+    diffing the compiled step's memory footprint against the AOT budget,
+    and drift beyond 10% warns (see memory_report.compare_with_aot)."""
     st = _STATE
     if st is None:
         return None
     from .step_report import build_step_report, write_step_report as _write
 
-    report = build_step_report(fn, *args, name=name, **kwargs)
+    report = build_step_report(fn, *args, name=name, aot_report=aot_report, **kwargs)
+    st.last_step_report = report  # flight-recorder forensics feed
     if st.out_dir is not None:
         _write(report, os.path.join(st.out_dir, f"{name}_report.json"))
     if report.get("flops") is not None:
         st.registry.gauge(f"step_report_{name}_flops").set(report["flops"])
     if report.get("peak_bytes") is not None:
         st.registry.gauge(f"step_report_{name}_peak_bytes").set(report["peak_bytes"])
+    drift = report.get("aot_drift")
+    if drift is not None:
+        st.registry.gauge(f"step_report_{name}_aot_drift_frac").set(drift["drift_frac"])
+        if drift["exceeds_tolerance"]:
+            import warnings
+
+            warnings.warn(
+                f"step report {name!r}: compiled memory footprint "
+                f"{drift['measured_bytes']:.3e} B drifts "
+                f"{drift['drift_frac'] * 100:+.1f}% from the AOT budget "
+                f"{drift['aot_bytes']:.3e} B ({drift['aot_source']}) — "
+                "beyond the 10% tolerance; re-derive the AOT report or find "
+                "the regression.",
+                stacklevel=2,
+            )
     return report
 
 

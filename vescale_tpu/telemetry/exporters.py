@@ -127,20 +127,43 @@ def parse_prometheus_text(text: str) -> Dict[str, float]:
     return out
 
 
+def _fmt_bytes(v: float) -> str:
+    """Humanize a byte count for the dashboard memory section."""
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024.0 or unit == "TiB":
+            return f"{v:.1f} {unit}" if unit != "B" else f"{int(v)} B"
+        v /= 1024.0
+    return f"{v:.1f} TiB"
+
+
 def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     """Human-readable fixed-width dump of the registry (the quick-look
-    answer to 'how is this run doing' without any external stack)."""
+    answer to 'how is this run doing' without any external stack).  Memory
+    gauges (the ``mem_`` namespace memtrack feeds) render as their own
+    section with humanized byte values."""
     snap = registry.snapshot()
     width = 78
     lines = ["=" * width, f"{title:^{width}}", "=" * width]
+    mem_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith("mem_")}
+    other_gauges = {n: v for n, v in snap["gauges"].items() if not n.startswith("mem_")}
     if snap["counters"]:
         lines.append("counters:")
         for name in sorted(snap["counters"]):
             lines.append(f"  {name:<48} {_fmt(snap['counters'][name]):>12}")
-    if snap["gauges"]:
+    if other_gauges:
         lines.append("gauges:")
-        for name in sorted(snap["gauges"]):
-            lines.append(f"  {name:<48} {snap['gauges'][name]:>12.6g}")
+        for name in sorted(other_gauges):
+            lines.append(f"  {name:<48} {other_gauges[name]:>12.6g}")
+    if mem_gauges:
+        lines.append("memory:")
+        for name in sorted(mem_gauges):
+            v = mem_gauges[name]
+            # "bytes" anywhere in the name: covers mem_tag_*_bytes AND the
+            # device gauges (mem_device<i>_bytes_in_use/peak_bytes_in_use/
+            # bytes_limit), which don't END with the suffix
+            shown = _fmt_bytes(v) if "bytes" in name else _fmt(v)
+            lines.append(f"  {name:<48} {shown:>16}")
     if snap["histograms"]:
         lines.append("histograms (rolling window):")
         for name in sorted(snap["histograms"]):

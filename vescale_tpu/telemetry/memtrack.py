@@ -1,0 +1,406 @@
+"""Live HBM attribution + OOM flight recorder.
+
+The runtime half of memory observability (memory_report.py holds the pure
+measurement functions):
+
+  1. **Tag registry** — the runtime wiring (darray factories, optimizer
+     state init, pipe-engine activation stash, checkpoint load buffers,
+     train-step outputs) tags the arrays it creates, so
+     ``jax.live_arrays()`` can be bucketed by OWNER (``params`` /
+     ``optimizer_state`` / ``grads`` / ``activation_stash`` /
+     ``checkpoint_buffers`` / ``untagged``) instead of being an anonymous
+     byte pile.  Registration is weakref-based: tagging never extends an
+     array's lifetime.
+  2. **Per-step sampling** — ``telemetry.record_step`` drives ``on_step``:
+     device memory gauges (host-RSS fallback on CPU), per-tag byte gauges,
+     a bounded history ring, and **leak detection** (N consecutive steps of
+     monotonic ``untagged`` growth warns once per run of growth).
+  3. **Flight recorder** — ``flight_recorder(step_fn)`` dumps a forensic
+     JSON bundle on RESOURCE_EXHAUSTED (census, device stats, last step
+     report, history, registry snapshot, ndtimeline tail) so an OOM at
+     step 40k is a file, not a bare stack trace.  ``dump_now()`` is the
+     on-demand path.
+
+Gating contract (same as the rest of telemetry): while dormant the module
+hooks ``tag_array`` / ``tag_tree`` ARE the no-op functions (``_noop_tag_array``
+/ ``_noop_tag_tree`` — tests assert identity), there is no tracker, no
+registry dict, no lock.  Callers must use ``memtrack.tag_array(...)``
+attribute access, never ``from memtrack import tag_array`` (which would
+freeze the dormant binding).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+import warnings
+import weakref
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from .memory_report import device_memory_stats, live_array_census
+
+__all__ = [
+    "TAGS",
+    "MemoryTracker",
+    "activate",
+    "deactivate",
+    "is_active",
+    "get_tracker",
+    "tagged",
+    "tag_array",
+    "tag_tree",
+    "flight_recorder",
+    "dump_now",
+    "maybe_dump_oom",
+]
+
+# the tag taxonomy (docs/observability.md) — anything else is user-defined
+TAGS = (
+    "params",
+    "optimizer_state",
+    "grads",
+    "activation_stash",
+    "checkpoint_buffers",
+    "untagged",
+)
+
+_TRACKER: Optional["MemoryTracker"] = None
+_TAG_STACK: List[str] = []  # ambient tag for factory hooks (tagged())
+
+
+# ------------------------------------------------------------ dormant hooks
+# These ARE the module's public hooks while dormant: a single no-op call per
+# factory/init site.  activate() rebinds the module attributes to the live
+# tracker's methods; deactivate() restores these exact references (the
+# gating test asserts identity against them).
+def _noop_tag_array(x, tag: Optional[str] = None):
+    return x
+
+
+def _noop_tag_tree(tree, tag: Optional[str] = None):
+    return tree
+
+
+tag_array = _noop_tag_array
+tag_tree = _noop_tag_tree
+
+
+@contextlib.contextmanager
+def tagged(tag: str):
+    """Ambient-tag scope: darray factory calls inside the block register
+    their results under ``tag``.  Harmless while dormant (one list append)."""
+    _TAG_STACK.append(tag)
+    try:
+        yield
+    finally:
+        _TAG_STACK.pop()
+
+
+def is_active() -> bool:
+    return _TRACKER is not None
+
+
+def get_tracker() -> Optional["MemoryTracker"]:
+    return _TRACKER
+
+
+# ----------------------------------------------------------------- tracker
+class MemoryTracker:
+    """Everything a live memory-tracking run owns (created ONLY by
+    ``telemetry.init(memtrack=True)``; its absence IS the off state)."""
+
+    def __init__(
+        self,
+        history: int = 16,
+        leak_steps: int = 5,
+        census_interval: int = 1,
+        top_k: int = 10,
+    ):
+        if census_interval < 1:
+            raise ValueError(f"census_interval must be >= 1, got {census_interval}")
+        self.history_len = history
+        self.leak_steps = leak_steps
+        self.census_interval = census_interval
+        self.top_k = top_k
+        # id(arr) -> (weakref, tag); the weakref callback evicts the entry,
+        # so the registry tracks LIVE arrays only and never extends lifetimes.
+        # RLock, not Lock: a GC cycle collection triggered by the insert
+        # allocation can run an eviction callback SYNCHRONOUSLY on the same
+        # thread while tag_array holds the lock — a plain Lock would deadlock
+        self._entries: Dict[int, tuple] = {}
+        self._lock = threading.RLock()
+        self.history: List[Dict[str, Any]] = []
+        self._last_untagged: Optional[int] = None
+        self._growth_run = 0
+        self._leak_warned = False
+        self.dumps_written = 0
+
+    # ------------------------------------------------------------ tagging
+    def tag_array(self, x, tag: Optional[str] = None):
+        """Register one array (or DArray — its physical leaf) under ``tag``
+        (or the ambient ``tagged()`` scope).  Tracers, non-weakrefable and
+        tagless arrays are skipped silently: tagging is advisory."""
+        tag = tag or (_TAG_STACK[-1] if _TAG_STACK else None)
+        if tag is None:
+            return x
+        arr = getattr(x, "_data", x)  # DArray -> physical jax.Array
+        if isinstance(arr, jax.core.Tracer):
+            return x
+        if not hasattr(arr, "nbytes"):
+            return x
+        key = id(arr)
+        try:
+            ref = weakref.ref(arr, lambda _r, k=key, s=self: s._evict(k))
+        except TypeError:
+            return x
+        with self._lock:
+            self._entries[key] = (ref, tag)
+        return x
+
+    def _evict(self, key: int) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def tag_tree(self, tree, tag: Optional[str] = None):
+        """Register every array leaf of a pytree (DArray leaves register
+        their physical arrays — DArray is a pytree node)."""
+        for leaf in jax.tree_util.tree_leaves(tree):
+            self.tag_array(leaf, tag)
+        return tree
+
+    def tag_of(self, arr) -> Optional[str]:
+        entry = self._entries.get(id(arr))
+        if entry is None:
+            return None
+        ref, tag = entry
+        return tag if ref() is arr else None  # guard id() reuse
+
+    @property
+    def num_tagged(self) -> int:
+        return len(self._entries)
+
+    # ----------------------------------------------------------- sampling
+    def census(self) -> Dict[str, Any]:
+        return live_array_census(self.tag_of, top_k=self.top_k)
+
+    def on_step(self, step: int, registry) -> Optional[Dict[str, Any]]:
+        """Per-step sample (driven by ``telemetry.record_step``): gauges
+        into the registry, a history entry, leak detection.  Returns the
+        compact memory record merged into the steps.jsonl line (None on
+        skipped census-interval steps)."""
+        if step % self.census_interval != 0:
+            return None
+        devices = device_memory_stats()
+        census = self.census()
+        tag_bytes = {t: b["bytes"] for t, b in census["tags"].items()}
+
+        for i, d in enumerate(devices):
+            if d["source"] == "host_rss":
+                if d["bytes_in_use"] is not None:
+                    registry.gauge("mem_host_rss_bytes").set(d["bytes_in_use"])
+                if d["peak_bytes_in_use"] is not None:
+                    registry.gauge("mem_host_peak_rss_bytes").set(d["peak_bytes_in_use"])
+                continue
+            # keyed by DEVICE ID, not list position: a device whose stats
+            # transiently fail is skipped by device_memory_stats, and a
+            # positional key would shift every later device's gauge onto the
+            # wrong chip (the exact misattribution this layer exists to avoid)
+            dev = d.get("id", i)
+            for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                if d.get(key) is not None:
+                    registry.gauge(f"mem_device{dev}_{key}").set(d[key])
+        for tag, nbytes in tag_bytes.items():
+            registry.gauge(f"mem_tag_{tag}_bytes").set(nbytes)
+        registry.gauge("mem_live_arrays").set(census["live_arrays"])
+
+        # leak detection: N consecutive steps of strictly monotonic untagged
+        # growth.  Warn once per run of growth — a real leak keeps growing,
+        # and re-warning every step would bury the signal it carries.
+        untagged = int(tag_bytes.get("untagged", 0))
+        if self._last_untagged is not None and untagged > self._last_untagged:
+            self._growth_run += 1
+        else:
+            self._growth_run = 0
+            self._leak_warned = False
+        self._last_untagged = untagged
+        registry.gauge("mem_untagged_growth_steps").set(self._growth_run)
+        if self._growth_run >= self.leak_steps and not self._leak_warned:
+            self._leak_warned = True
+            registry.counter("mem_leak_warnings_total").inc()
+            warnings.warn(
+                f"memtrack: untagged live-array bytes grew monotonically for "
+                f"{self._growth_run} consecutive steps (now {untagged} B) — "
+                "possible leak.  telemetry.dump_now() writes a tagged census "
+                "to identify the owner.",
+                stacklevel=3,
+            )
+
+        sample = {
+            "step": step,
+            "ts": time.time(),
+            "devices": devices,
+            "tags": tag_bytes,
+            "live_arrays": census["live_arrays"],
+            "untagged_growth_steps": self._growth_run,
+        }
+        self.history.append(sample)
+        if len(self.history) > self.history_len:
+            del self.history[: len(self.history) - self.history_len]
+        return {
+            "tags": tag_bytes,
+            "devices": [
+                {k: d.get(k) for k in ("source", "bytes_in_use", "peak_bytes_in_use")}
+                for d in devices
+            ],
+            "untagged_growth_steps": self._growth_run,
+        }
+
+    # ----------------------------------------------------- flight recorder
+    def flight_record(self, reason: str, exception: Optional[str] = None) -> Dict[str, Any]:
+        """Build the forensic bundle (the OOM dump / dump_now payload)."""
+        from . import api as _api  # late: api imports this module at top
+
+        st = _api.get_state()
+        bundle: Dict[str, Any] = {
+            "reason": reason,
+            "ts": time.time(),
+            "step": st.step if st is not None else None,
+            "rank": st.rank if st is not None else None,
+            "exception": exception,
+            "device_memory": device_memory_stats(),
+            "census": self.census(),
+            "history": list(self.history),
+            "last_step_report": getattr(st, "last_step_report", None),
+            "registry": st.registry.snapshot() if st is not None else None,
+            "ndtimeline_tail": _ndtimeline_tail(),
+        }
+        return bundle
+
+
+def _ndtimeline_tail(n: int = 200) -> Optional[List[Dict[str, Any]]]:
+    """Last ``n`` buffered (un-flushed) profiler spans, when the profiler is
+    live — the 'what was the run doing' context of an OOM dump."""
+    from ..ndtimeline import api as _nd
+
+    if not _nd.is_active():
+        return None
+    return [
+        {
+            "metric": s.metric,
+            "start": s.start,
+            "duration": s.duration,
+            "step": s.step,
+            "rank": s.rank,
+            "tags": s.tags,
+        }
+        for s in _nd.get_manager().tail(n)
+    ]
+
+
+# --------------------------------------------------------------- gate flips
+def activate(
+    history: int = 16,
+    leak_steps: int = 5,
+    census_interval: int = 1,
+    top_k: int = 10,
+) -> MemoryTracker:
+    """Create the tracker and bind the live hooks (called by
+    ``telemetry.init``; do not call directly unless you know why)."""
+    global _TRACKER, tag_array, tag_tree
+    _TRACKER = MemoryTracker(
+        history=history,
+        leak_steps=leak_steps,
+        census_interval=census_interval,
+        top_k=top_k,
+    )
+    tag_array = _TRACKER.tag_array
+    tag_tree = _TRACKER.tag_tree
+    return _TRACKER
+
+
+def deactivate() -> None:
+    """Drop the tracker and restore the no-op hook references."""
+    global _TRACKER, tag_array, tag_tree
+    _TRACKER = None
+    tag_array = _noop_tag_array
+    tag_tree = _noop_tag_tree
+
+
+# ------------------------------------------------------------------- dumps
+def _is_oom(exc: BaseException) -> bool:
+    """Does this exception look like a device-memory exhaustion?  String
+    match on purpose: jax surfaces XLA's RESOURCE_EXHAUSTED through several
+    exception types (XlaRuntimeError is a plain RuntimeError subclass)."""
+    s = str(exc)
+    return (
+        "RESOURCE_EXHAUSTED" in s
+        or "resource exhausted" in s.lower()
+        or "out of memory" in s.lower()
+    )
+
+
+def dump_now(
+    path: Optional[str] = None,
+    reason: str = "manual",
+    exception: Optional[str] = None,
+) -> Optional[Dict[str, Any]]:
+    """Write a flight-recorder bundle on demand.  Default path is
+    ``<out_dir>/flight_record_<step>_<seq>.json`` when telemetry has an
+    out_dir (in-memory runs just get the dict back).  None while dormant."""
+    tracker = _TRACKER
+    if tracker is None:
+        return None
+    from . import api as _api
+
+    bundle = tracker.flight_record(reason, exception=exception)
+    st = _api.get_state()
+    if path is None and st is not None and st.out_dir is not None:
+        tracker.dumps_written += 1
+        path = os.path.join(
+            st.out_dir, f"flight_record_{bundle['step']}_{tracker.dumps_written}.json"
+        )
+    if path is not None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(bundle, f, indent=2, default=str)
+        bundle["path"] = path
+    if st is not None:
+        st.registry.counter("mem_flight_records_total").inc()
+    return bundle
+
+
+def maybe_dump_oom(exc: BaseException) -> Optional[Dict[str, Any]]:
+    """The shared OOM-dump handler: if tracking is live and ``exc`` looks
+    like memory exhaustion, write a flight record.  Never raises — the dump
+    must not mask the OOM the caller is about to re-raise.  Call this from
+    any step-shaped wrapper's except block (train.py and pipe/engine.py
+    do); ``flight_recorder`` is the decorator form."""
+    if _TRACKER is None or not _is_oom(exc):
+        return None
+    try:
+        return dump_now(reason=f"oom:{type(exc).__name__}", exception=repr(exc))
+    except Exception:
+        return None
+
+
+def flight_recorder(fn: Callable) -> Callable:
+    """Wrap a train/pipe step so RESOURCE_EXHAUSTED writes a forensic dump
+    before propagating.  Dormant runs pay one try/except frame; the dump
+    itself never masks the original exception (a failing dump is swallowed
+    — the OOM is the signal that must reach the caller)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:
+            maybe_dump_oom(e)
+            raise
+
+    return wrapped

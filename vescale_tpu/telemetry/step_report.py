@@ -43,6 +43,7 @@ def build_step_report(
     *args,
     static_argnums=(),
     name: str = "step",
+    aot_report=None,
     **kwargs,
 ) -> Dict[str, Any]:
     """Lower+compile ``fn(*args, **kwargs)`` (or reuse ``fn.lower`` when fn
@@ -54,7 +55,12 @@ def build_step_report(
     ``argument_bytes``/``output_bytes``/``temp_bytes``/``alias_bytes``/
     ``generated_code_bytes``, and ``collectives`` (the comm_mode counter over
     the optimized HLO).  Fields XLA cannot provide on a backend come back
-    None rather than raising — the report must degrade, not fail a run."""
+    None rather than raising — the report must degrade, not fail a run.
+
+    ``aot_report`` (path or loaded AOT_*_REPORT.json dict): attaches an
+    ``aot_drift`` section diffing the measured memory footprint against the
+    AOT budget (memory_report.compare_with_aot; None when either side lacks
+    a usable byte count)."""
     if hasattr(fn, "lower"):
         lowered = fn.lower(*args, **kwargs)
     else:
@@ -104,6 +110,10 @@ def build_step_report(
     except Exception:
         text = lowered.as_text()
     report["collectives"] = count_collectives(text)
+    if aot_report is not None:
+        from .memory_report import compare_with_aot
+
+        report["aot_drift"] = compare_with_aot(report, aot_report)
     return report
 
 

@@ -263,11 +263,27 @@ def make_train_step(
     from .ndtimeline import api as _nd
     from .ndtimeline.predefined import TRAIN_STEP
 
+    from .telemetry import memtrack as _memtrack
+
     @functools.wraps(jitted)
     def timed_step(*args, **kwargs):
         t0 = time.perf_counter()
-        with _nd.ndtimeit(TRAIN_STEP):
-            out = jitted(*args, **kwargs)
+        try:
+            with _nd.ndtimeit(TRAIN_STEP):
+                out = jitted(*args, **kwargs)
+        except BaseException as e:
+            # OOM flight recorder (telemetry/memtrack.py): a
+            # RESOURCE_EXHAUSTED at step 40k leaves a forensic bundle
+            # (tagged census, device stats, last reports) instead of a bare
+            # stack trace.  Gated — dormant runs pay this try frame only.
+            _memtrack.maybe_dump_oom(e)
+            raise
+        # re-tag the donated/updated outputs: each jitted call returns FRESH
+        # arrays, and without this the whole model would age into the
+        # untagged bucket after one step (and trip the leak detector)
+        _memtrack.tag_tree(out[0], "params")
+        if len(out) > 1:
+            _memtrack.tag_tree(out[1], "optimizer_state")
         if auto_inc_step and _nd.is_active():
             mgr = _nd.get_manager()
             g = _AUTO_STEP_GUARD
