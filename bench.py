@@ -325,6 +325,166 @@ def bench_memtrack():
     }))
 
 
+def bench_resilience():
+    """Resilience-overhead rung (VESCALE_BENCH=resilience): the SAME
+    compiled step timed in a bare python loop vs inside ``run_resilient``
+    with the whole layer ARMED — faultsim schedule installed (but far in
+    the future, so quiescent), retry-wrapped storage/loader I/O, anomaly
+    guard live, preemption flag checked — and no faults firing.  The
+    reported ``overhead_frac`` is the steady-state price of leaving
+    recovery on; the acceptance bar is < 1%.  Both loops host-fetch the
+    loss each step (the anomaly guard needs it; an uninstrumented loop
+    that never syncs would make the comparison dispatch-vs-compute)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from vescale_tpu.dmodule import parallelize_module
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.models.llama import Llama, LlamaConfig, llama_plan
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
+    from vescale_tpu.parallel.optimizer import DistributedOptimizer
+    from vescale_tpu.checkpoint import CheckpointManager
+    from vescale_tpu.resilience import AnomalyPolicy, Fault, faultsim, run_resilient
+    from vescale_tpu.train import make_train_step
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    B, T = (4, 1024) if on_tpu else (2, 64)
+    cfg = LlamaConfig(
+        vocab_size=2048 if on_tpu else 128,
+        hidden_size=256 if on_tpu else 32,
+        intermediate_size=512 if on_tpu else 64,
+        num_hidden_layers=4 if on_tpu else 2,
+        num_attention_heads=4 if on_tpu else 2,
+        num_key_value_heads=4 if on_tpu else 2,
+        max_position_embeddings=T,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    mesh = DeviceMesh(("dp", "tp"), (1, 1), devices=devices[:1])
+    dm = parallelize_module(Llama(cfg), mesh, llama_plan(mesh, sequence_parallel=False))
+    params = dm.init(jax.random.key(0), jnp.ones((2, T), jnp.int32))["params"]
+    dopt = DistributedOptimizer(optax.adamw(1e-3))
+    opt_state = dopt.init(params)
+    step = make_train_step(
+        dm, dopt, lambda lg, b: cross_entropy_loss(lg, b["target"]), donate=False
+    )
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T + 1)), jnp.int32)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+    # CPU steps are ~1 ms: the median needs a deep sample to resolve a <1%
+    # delta on a shared host; TPU steps are long enough for a short loop
+    iters = 30 if on_tpu else 100
+
+    # warmup/compile once; both loops then run the identical program
+    p, s = params, opt_state
+    for _ in range(3):
+        p, s, loss = step(p, s, batch)
+    float(loss)
+
+    def _median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    def bare_loop():
+        p, s = params, opt_state
+        ts = [time.perf_counter()]
+        for _ in range(iters):
+            p, s, loss = step(p, s, batch)
+            float(loss)  # the sync the anomaly guard also pays
+            ts.append(time.perf_counter())
+        # median, not mean: a single GC pause / scheduler hiccup on a
+        # millisecond-scale CPU step would otherwise dominate the delta
+        return _median([b - a for a, b in zip(ts, ts[1:])])
+
+    def resilient_loop():
+        root = tempfile.mkdtemp(prefix="bench_resilience_")
+        # armed but quiescent: schedule installed, nothing ever fires
+        faultsim.arm([Fault("preempt", at_step=10**9)])
+        ts = []
+        try:
+            run_resilient(
+                step_fn=step,
+                params=params,
+                opt_state=opt_state,
+                manager=CheckpointManager(root, keep=1),
+                batch_fn=lambda i: batch,
+                total_steps=iters + 1,  # the final step always saves;
+                save_every=10**9,       # keep it out of the timed window
+                async_save=False,
+                anomaly=AnomalyPolicy(threshold=3),
+                install_signal_handlers=True,
+                on_step=lambda i, l: ts.append(time.perf_counter()),
+            )
+        finally:
+            faultsim.disarm()
+        return _median([b - a for a, b in zip(ts, ts[1:])][: iters - 1])
+
+    def layer_host_cost():
+        """Pure host cost per step of the armed loop machinery, isolated
+        from XLA/scheduler noise by a no-op step_fn: the resilience layer
+        adds ONLY host-side bookkeeping (it runs the same compiled
+        program), so its true per-step price is (armed - bare) around a
+        step that costs ~nothing."""
+        nul_iters = 2000
+        nop_out = ({"w": np.float32(0)}, {"m": np.float32(0)}, 1.0)
+
+        def nop_step(p, o, b, k=None):
+            return nop_out
+
+        t0 = time.perf_counter()
+        for _ in range(nul_iters):
+            out = nop_step(None, None, batch)
+            float(out[2])
+        bare_nop = (time.perf_counter() - t0) / nul_iters
+        root = tempfile.mkdtemp(prefix="bench_resilience_nop_")
+        faultsim.arm([Fault("preempt", at_step=10**9)])
+        ts = []
+        try:
+            run_resilient(
+                step_fn=nop_step,
+                params=nop_out[0],
+                opt_state=nop_out[1],
+                manager=CheckpointManager(root, keep=1),
+                batch_fn=lambda i: batch,
+                total_steps=nul_iters + 1,
+                save_every=10**9,
+                async_save=False,
+                anomaly=AnomalyPolicy(threshold=3),
+                install_signal_handlers=True,
+                on_step=lambda i, l: ts.append(time.perf_counter()),
+            )
+        finally:
+            faultsim.disarm()
+        deltas = sorted(b - a for a, b in zip(ts, ts[1:]))[: nul_iters - 1]
+        armed_nop = sum(deltas) / len(deltas)
+        return max(0.0, armed_nop - bare_nop)
+
+    # interleave and take best-of-two each: bounds drift on shared hosts
+    base = bare_loop()
+    armed = resilient_loop()
+    base = min(base, bare_loop())
+    armed = min(armed, resilient_loop())
+    layer = layer_host_cost()
+    print(json.dumps({
+        # "_cpu" suffix off-TPU: the orchestrator's lastgood heuristic keys
+        # "is this a real chip number" on the metric name containing "cpu".
+        # Headline value = deterministic layer host cost / real step time;
+        # wall_delta_frac is the raw (noisier) wall-clock cross-check.
+        "metric": "resilience_overhead_frac" if on_tpu else "resilience_overhead_frac_cpu",
+        "value": round(layer / base, 5) if base > 0 else None,
+        "unit": "fraction",
+        "layer_host_us_per_step": round(layer * 1e6, 2),
+        "step_ms_bare": round(base * 1e3, 3),
+        "step_ms_armed": round(armed * 1e3, 3),
+        "wall_delta_frac": round((armed - base) / base, 4) if base > 0 else None,
+        "iters": iters,
+        "acceptance_lt": 0.01,
+    }))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -439,6 +599,8 @@ def _dispatch():
         bench_longctx()
     elif which == "memtrack":
         bench_memtrack()
+    elif which == "resilience":
+        bench_resilience()
     elif which == "redistribute":
         # multi-hop planner battery (VESCALE_BENCH=redistribute): plan
         # length, bytes moved and retrace count per representative

@@ -1,0 +1,851 @@
+"""Resilience layer tests — faultsim-driven recovery paths.
+
+Every failure mode is exercised through deterministic injection
+(resilience/faultsim.py): storage faults absorbed by retry, retry
+exhaustion, torn commits, corrupt-checkpoint quarantine, preemption with
+sample-exact resume, anomaly rollback, and bounded in-process restarts.
+The train step here is a small pure-numpy function — the recovery
+machinery is host-side and model-agnostic; scripts/resilience_smoke.py
+(wired in at the bottom) runs the same scenarios through a real compiled
+jax train step.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from vescale_tpu.checkpoint import CheckpointManager
+from vescale_tpu.checkpoint.storage import FileSystemStorage
+from vescale_tpu.data import TokenDataLoader
+from vescale_tpu.resilience import (
+    AnomalyPolicy,
+    Fault,
+    PreemptionHandler,
+    RetryPolicy,
+    faultsim,
+    parse_schedule,
+    reset_default_policies,
+    run_resilient,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_env(monkeypatch):
+    """Python-pool storage io (fault hooks sit on the Python path), fast
+    backoff, fresh env-derived policies, disarmed faultsim around each
+    test."""
+    monkeypatch.setenv("VESCALE_NATIVE_CKPT_IO", "0")
+    monkeypatch.setenv("VESCALE_IO_BACKOFF_BASE", "0.001")
+    reset_default_policies()
+    faultsim.disarm()
+    yield
+    faultsim.disarm()
+    reset_default_policies()
+
+
+# ------------------------------------------------------------ toy train fn
+def _step_fn(params, opt, batch):
+    w = params["w"] + batch.mean(axis=0).astype(np.float32) * 0.01
+    return {"w": w}, {"m": opt["m"] + 1}, float(np.abs(w).sum())
+
+
+def _batch_fn(i):
+    rng = np.random.default_rng(1000 + i)
+    return rng.normal(size=(2, 4)).astype(np.float32)
+
+
+def _run_kwargs(total_steps=12, **over):
+    kw = dict(
+        step_fn=_step_fn,
+        params={"w": np.zeros(4, np.float32)},
+        opt_state={"m": np.zeros(4, np.float32)},
+        total_steps=total_steps,
+        batch_fn=_batch_fn,
+        save_every=3,
+        async_save=False,
+        install_signal_handlers=False,
+    )
+    kw.update(over)
+    return kw
+
+
+def _reference(tmp_path, total_steps=12):
+    root = str(tmp_path / "ref_ckpts")
+    return run_resilient(manager=CheckpointManager(root), **_run_kwargs(total_steps))
+
+
+# ================================================================= faultsim
+def test_faultsim_gating_noop_references():
+    """Disarmed hooks ARE the no-op function references (zero-overhead
+    contract, same identity pattern as telemetry/memtrack)."""
+    assert faultsim.check is faultsim._noop_check
+    assert faultsim.fires is faultsim._noop_fires
+    faultsim.arm([Fault("oom", at_call=0)])
+    assert faultsim.check is not faultsim._noop_check
+    faultsim.disarm()
+    assert faultsim.check is faultsim._noop_check
+    assert faultsim.fires is faultsim._noop_fires
+
+
+def test_faultsim_call_and_step_triggers():
+    faultsim.arm([Fault("storage_read", at_call=1, count=2),
+                  Fault("preempt", at_step=5)])
+    faultsim.check("storage_read")  # call 0: clean
+    for _ in range(2):  # calls 1, 2: fire
+        with pytest.raises(OSError):
+            faultsim.check("storage_read")
+    faultsim.check("storage_read")  # call 3: clean again
+    faultsim.set_step(4)
+    assert not faultsim.fires("preempt")
+    faultsim.set_step(5)
+    assert faultsim.fires("preempt")
+    # total-count guard: a replayed step must NOT re-fire the fault
+    assert not faultsim.fires("preempt")
+
+
+def test_faultsim_seeded_probability_replays():
+    def draw():
+        faultsim.arm([Fault("loader_next", p=0.3, seed=7)])
+        out = [faultsim.get_injector()._consult("loader_next", "") for _ in range(50)]
+        faultsim.disarm()
+        return out
+
+    a, b = draw(), draw()
+    assert a == b and any(a) and not all(a)
+
+
+def test_run_resilient_arms_from_env(tmp_path, monkeypatch):
+    """VESCALE_FAULTSIM is honored by run_resilient when nothing armed."""
+    monkeypatch.setenv("VESCALE_FAULTSIM", "preempt:step=4")
+    res = run_resilient(manager=CheckpointManager(str(tmp_path / "c")), **_run_kwargs())
+    assert res.status == "preempted" and res.step == 3
+
+
+def test_faultsim_env_schedule_parse():
+    faults = parse_schedule("storage_write:call=3;nonfinite_loss:step=6,count=4;oom:p=0.5,seed=9")
+    assert [f.kind for f in faults] == ["storage_write", "nonfinite_loss", "oom"]
+    assert faults[0].at_call == 3 and faults[1].count == 4 and faults[2].seed == 9
+    with pytest.raises(ValueError):
+        parse_schedule("storage_write:frobnicate=1")
+    with pytest.raises(ValueError):
+        parse_schedule("not_a_kind:call=0")
+    with pytest.raises(ValueError):
+        Fault("oom", at_call=1, at_step=2)  # exactly one trigger
+
+
+# ==================================================================== retry
+def test_retry_absorbs_transient_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert RetryPolicy(max_attempts=3, base_backoff=0.0).call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_exhausted_reraises_original():
+    def always():
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        RetryPolicy(max_attempts=2, base_backoff=0.0).call(always)
+
+
+def test_retry_no_retry_subtypes_pass_through():
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        RetryPolicy(max_attempts=5, base_backoff=0.0).call(missing)
+    assert len(calls) == 1  # no retry can make a missing file appear
+
+
+def test_retry_backoff_deterministic_and_bounded():
+    p = RetryPolicy(max_attempts=8, base_backoff=0.1, max_backoff=0.5, jitter=0.25)
+    a = [p.backoff_for(i) for i in range(1, 8)]
+    b = [p.backoff_for(i) for i in range(1, 8)]
+    assert a == b  # seeded jitter replays
+    assert all(d <= 0.5 * 1.25 + 1e-9 for d in a)
+    assert RetryPolicy(jitter=0.0, base_backoff=0.1).backoff_for(2) == pytest.approx(0.2)
+
+
+def test_retry_env_knobs(monkeypatch):
+    monkeypatch.setenv("VESCALE_CKPT_RETRIES", "7")
+    monkeypatch.setenv("VESCALE_IO_BACKOFF_BASE", "0.125")
+    reset_default_policies()
+    from vescale_tpu.resilience.retry import ckpt_policy
+
+    pol = ckpt_policy()
+    assert pol.max_attempts == 7 and pol.base_backoff == 0.125
+
+
+def test_storage_write_retry_then_succeed(tmp_path):
+    """Injected write fault on one attempt; the retry commits the bytes."""
+    faultsim.arm([Fault("storage_write", at_call=0)])
+    st = FileSystemStorage(str(tmp_path / "s"))
+    st.write_bytes("a/b.bin", b"payload")
+    assert st.read_bytes("a/b.bin") == b"payload"
+    assert faultsim.get_injector().fired_total["storage_write"] == 1
+
+
+def test_storage_retry_exhausted_hard_failure(tmp_path, monkeypatch):
+    monkeypatch.setenv("VESCALE_CKPT_RETRIES", "2")
+    reset_default_policies()
+    faultsim.arm([Fault("storage_write", at_call=0, count=5)])
+    st = FileSystemStorage(str(tmp_path / "s"))
+    with pytest.raises(OSError, match="injected storage write"):
+        st.write_bytes("x.bin", b"data")
+    assert not os.path.exists(tmp_path / "s" / "x.bin")
+
+
+def test_checkpoint_save_survives_storage_fault(tmp_path):
+    """A full checkpoint save with a transient write fault still commits;
+    the torn-save guarantee holds when retries are exhausted instead."""
+    faultsim.arm([Fault("storage_write", at_call=1)])
+    mgr = CheckpointManager(str(tmp_path / "c"), keep=2)
+    mgr.save(0, {"model": {"w": np.arange(8, dtype=np.float32)}})
+    assert mgr.latest_step() == 0
+    out = mgr.restore({"model": {"w": np.zeros(8, np.float32)}})
+    np.testing.assert_array_equal(out["model"]["w"], np.arange(8, dtype=np.float32))
+
+
+def test_torn_save_invisible_after_injected_crash(tmp_path, monkeypatch):
+    """Retry-exhausted meta write = injected crash mid-commit: the step
+    must never read as committed."""
+    monkeypatch.setenv("VESCALE_CKPT_RETRIES", "1")
+    reset_default_policies()
+    mgr = CheckpointManager(str(tmp_path / "c"), keep=2)
+    mgr.save(0, {"model": {"w": np.ones(4, np.float32)}})
+    # every write from here on fails — the step-1 save dies before commit
+    faultsim.arm([Fault("storage_write", at_call=0, count=10**6)])
+    with pytest.raises(OSError):
+        mgr.save(1, {"model": {"w": np.full(4, 2.0, np.float32)}})
+    faultsim.disarm()
+    assert CheckpointManager(str(tmp_path / "c")).latest_step() == 0
+
+
+# ====================================================== torn-commit metas
+def test_zero_byte_meta_not_committed(tmp_path):
+    """Regression (satellite): a crash mid-commit-write leaves a zero-byte
+    meta.json — it must NOT count as restorable."""
+    root = str(tmp_path / "c")
+    mgr = CheckpointManager(root, keep=3)
+    mgr.save(3, {"model": {"w": np.ones(2, np.float32)}})
+    torn = os.path.join(root, "step_0000000009")
+    os.makedirs(torn)
+    open(os.path.join(torn, "meta.json"), "w").close()  # zero-byte marker
+    assert CheckpointManager(root).latest_step() == 3
+
+
+def test_unparseable_meta_not_committed(tmp_path):
+    root = str(tmp_path / "c")
+    mgr = CheckpointManager(root, keep=3)
+    mgr.save(3, {"model": {"w": np.ones(2, np.float32)}})
+    torn = os.path.join(root, "step_0000000009")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "meta.json"), "w") as f:
+        f.write('{"arrays": {"model/w": ')  # truncated mid-write
+    fresh = CheckpointManager(root)
+    assert fresh.latest_step() == 3
+    assert fresh._committed_steps() == [3]
+
+
+def test_meta_validation_cached(tmp_path):
+    root = str(tmp_path / "c")
+    mgr = CheckpointManager(root, keep=3)
+    mgr.save(1, {"model": {"w": np.ones(2, np.float32)}})
+    meta = os.path.join(mgr.step_path(1), "meta.json")
+    assert mgr._committed_steps() == [1]
+    assert meta in mgr._meta_ok  # parsed once, cached by (size, mtime)
+    key = mgr._meta_ok[meta]
+    assert mgr._committed_steps() == [1]
+    assert mgr._meta_ok[meta] == key
+
+
+# ============================================================== quarantine
+def test_quarantine_corrupt_committed_step(tmp_path):
+    ref = _reference(tmp_path, total_steps=13)
+    root = str(tmp_path / "c")
+    run_resilient(manager=CheckpointManager(root), **_run_kwargs())
+    bad = sorted(glob.glob(os.path.join(root, "step_*")))[-1]
+    for f in glob.glob(os.path.join(bad, "data", "**", "*.npy"), recursive=True):
+        os.remove(f)  # committed but unloadable
+    with pytest.warns(UserWarning, match="quarantined"):
+        res = run_resilient(manager=CheckpointManager(root), **_run_kwargs(13))
+    assert res.quarantined == 1
+    # forensic copy kept; the step dir itself may be recreated by the
+    # resumed run's own save at the same step number
+    assert os.path.exists(bad + ".corrupt")
+    assert res.status == "completed" and res.step == 12
+    # replay from the older checkpoint converges to the reference exactly
+    np.testing.assert_array_equal(res.params["w"], ref.params["w"])
+
+
+def test_manager_quarantine_api(tmp_path):
+    root = str(tmp_path / "c")
+    mgr = CheckpointManager(root, keep=3)
+    mgr.save(1, {"model": {"w": np.ones(2, np.float32)}})
+    mgr.save(2, {"model": {"w": np.ones(2, np.float32)}})
+    dst = mgr.quarantine(2)
+    assert dst.endswith("step_0000000002.corrupt") and os.path.exists(dst)
+    assert mgr.latest_step() == 1
+
+
+# ========================================================== loader resume
+@pytest.fixture(scope="module")
+def token_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("resil_data") / "train.bin"
+    rng = np.random.default_rng(0)
+    rng.integers(0, 50000, 100_000).astype(np.uint16).tofile(p)
+    return str(p)
+
+
+def test_loader_state_roundtrip_forward(token_file):
+    a = TokenDataLoader(token_file, batch=2, seq_len=32, seed=5)
+    for _ in range(5):
+        a.next()
+    st = a.state()
+    assert st["batches_served"] == 5 and st["seed"] == 5
+    b = TokenDataLoader(token_file, batch=2, seq_len=32, seed=5)
+    b.load_state(st)  # native vdl_seek fast-forward
+    np.testing.assert_array_equal(a.next()["input"], b.next()["input"])
+    a.close(), b.close()
+
+
+def test_loader_state_rewind(token_file):
+    a = TokenDataLoader(token_file, batch=2, seq_len=16, seed=3)
+    batches = [a.next()["input"].copy() for _ in range(6)]
+    st2 = dict(a.state(), batches_served=2)
+    a.load_state(st2)  # backward: reopen + seek
+    np.testing.assert_array_equal(a.next()["input"], batches[2])
+    np.testing.assert_array_equal(a.next()["input"], batches[3])
+    a.close()
+
+
+def test_loader_state_identity_mismatch_raises(token_file):
+    a = TokenDataLoader(token_file, batch=2, seq_len=16, seed=3)
+    with pytest.raises(ValueError, match="dp_rank"):
+        a.load_state({"batches_served": 0, "seed": 3, "dp_rank": 1, "dp_world": 2,
+                      "batch": 2, "seq_len": 16})
+    with pytest.raises(ValueError, match="seed"):
+        a.load_state(dict(a.state(), seed=4))
+    a.close()
+
+
+def test_loader_error_includes_rc_and_path(token_file):
+    """Satellite: the native failure surfaces rc + path, not a bare
+    'native loader failed'."""
+    a = TokenDataLoader(token_file, batch=2, seq_len=16, seed=1)
+    real = a._lib
+
+    class _BadLib:
+        def __getattr__(self, name):  # delegate everything but vdl_next
+            return getattr(real, name)
+
+        @staticmethod
+        def vdl_next(h, x, y):
+            return -7
+
+    a._lib = _BadLib()
+    try:
+        with pytest.raises(RuntimeError) as ei:
+            a._fetch()
+        msg = str(ei.value)
+        assert "rc=-7" in msg and token_file in msg and "batch_index=0" in msg
+    finally:
+        a._lib = real
+        a.close()
+
+
+def test_loader_retry_on_injected_fault(token_file, monkeypatch):
+    monkeypatch.setenv("VESCALE_LOADER_RETRIES", "3")
+    reset_default_policies()
+    faultsim.arm([Fault("loader_next", at_call=0)])
+    a = TokenDataLoader(token_file, batch=2, seq_len=16, seed=1)
+    b = TokenDataLoader(token_file, batch=2, seq_len=16, seed=1)
+    faultsim.disarm()
+    # the retried fetch returns the SAME batch a clean run gets
+    xa = a.next()["input"]
+    faultsim.arm([Fault("loader_next", at_call=0)])
+    xb = b.next()["input"]
+    np.testing.assert_array_equal(xa, xb)
+    a.close(), b.close()
+
+
+def test_loader_retry_exhausted(token_file, monkeypatch):
+    monkeypatch.setenv("VESCALE_LOADER_RETRIES", "2")
+    reset_default_policies()
+    faultsim.arm([Fault("loader_next", at_call=0, count=10)])
+    a = TokenDataLoader(token_file, batch=2, seq_len=16, seed=1)
+    with pytest.raises(RuntimeError, match="injected native loader"):
+        a.next()
+    a.close()
+
+
+def test_loader_concurrent_close_idempotent(token_file):
+    a = TokenDataLoader(token_file, batch=2, seq_len=16, seed=1)
+    errs = []
+
+    def _close():
+        try:
+            for _ in range(10):
+                a.close()
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=_close) for _ in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs and a._h is None
+
+
+# ============================================================== preemption
+def test_preemption_handler_signal_and_programmatic():
+    h = PreemptionHandler().install()
+    try:
+        assert not h.requested()
+        import signal
+
+        os.kill(os.getpid(), signal.SIGTERM)
+        # delivery happens between bytecodes on the main thread
+        for _ in range(100):
+            if h.requested():
+                break
+        assert h.requested() and h.signum == signal.SIGTERM
+        h.clear()
+        assert not h.requested()
+        h.request()
+        assert h.requested()
+    finally:
+        h.uninstall()
+
+
+def test_preempt_emergency_save_and_sample_exact_resume(tmp_path):
+    ref = _reference(tmp_path)
+    root = str(tmp_path / "c")
+    faultsim.arm([Fault("preempt", at_step=7)])
+    res = run_resilient(manager=CheckpointManager(root), **_run_kwargs())
+    faultsim.disarm()
+    assert res.status == "preempted" and res.step == 6
+    assert res.emergency_save_step == 6  # step 5 had a periodic save; 6 did not
+    assert CheckpointManager(root).latest_step() == 6
+    res2 = run_resilient(manager=CheckpointManager(root), **_run_kwargs())
+    assert res2.status == "completed" and res2.step == 11
+    np.testing.assert_array_equal(res2.params["w"], ref.params["w"])
+    assert res2.losses[11] == ref.losses[11]  # bit-identical, not just close
+
+
+def test_preempt_right_after_periodic_save_skips_duplicate(tmp_path):
+    root = str(tmp_path / "c")
+    faultsim.arm([Fault("preempt", at_step=6)])  # step 5 just saved
+    res = run_resilient(manager=CheckpointManager(root), **_run_kwargs())
+    faultsim.disarm()
+    assert res.status == "preempted" and res.step == 5
+    assert res.emergency_save_step is None  # latest committed already == 5
+
+
+# ======================================================== anomaly rollback
+def test_nan_burst_rollback_replay_bit_exact(tmp_path):
+    ref = _reference(tmp_path)
+    root = str(tmp_path / "c")
+    faultsim.arm([Fault("nonfinite_loss", at_step=7, count=2)])
+    res = run_resilient(
+        manager=CheckpointManager(root),
+        anomaly=AnomalyPolicy(threshold=2),
+        **_run_kwargs(),
+    )
+    faultsim.disarm()
+    assert res.status == "completed"
+    assert res.rollbacks == 1 and res.anomaly_steps == 2
+    np.testing.assert_array_equal(res.params["w"], ref.params["w"])
+    assert res.losses[11] == ref.losses[11]
+
+
+def test_anomaly_below_threshold_no_rollback(tmp_path):
+    root = str(tmp_path / "c")
+    faultsim.arm([Fault("nonfinite_loss", at_step=7, count=1)])
+    res = run_resilient(
+        manager=CheckpointManager(root),
+        anomaly=AnomalyPolicy(threshold=3),
+        **_run_kwargs(),
+    )
+    faultsim.disarm()
+    assert res.rollbacks == 0 and res.anomaly_steps == 1
+
+
+def test_optimizer_skip_counts_as_anomaly(tmp_path):
+    """skip_count > 0 in the opt state (DistributedOptimizer dynamic loss
+    scale) feeds the same guard as non-finite loss."""
+    root = str(tmp_path / "c")
+
+    def skip_step(params, opt, batch):
+        p, o, loss = _step_fn(params, opt, batch)
+        skipping = 4 <= int(o["m"][0]) <= 5  # steps 3..4 read as skipped
+        return p, {**o, "loss_scale": {"scale": 1.0, "skip_count": int(skipping)}}, loss
+
+    res = run_resilient(
+        manager=CheckpointManager(root),
+        anomaly=AnomalyPolicy(threshold=5),  # streak of 2 stays below
+        **_run_kwargs(step_fn=skip_step),
+    )
+    assert res.anomaly_steps >= 2 and res.rollbacks == 0
+
+
+def test_loss_spike_zscore_detection(tmp_path):
+    root = str(tmp_path / "c")
+
+    def spiky(params, opt, batch):
+        p, o, _ = _step_fn(params, opt, batch)
+        i = int(o["m"][0]) - 1
+        loss = 1.0 + 0.001 * i + (1000.0 if i == 30 else 0.0)
+        return p, o, loss
+
+    res = run_resilient(
+        manager=CheckpointManager(root),
+        anomaly=AnomalyPolicy(threshold=1, zscore=8.0, min_history=10),
+        **_run_kwargs(total_steps=40, step_fn=spiky, save_every=10),
+    )
+    assert res.anomaly_steps >= 1 and res.rollbacks >= 1
+    assert res.status == "completed"
+
+
+def test_recurrent_anomaly_escalates_to_data_skip(tmp_path):
+    """A data-dependent anomaly (recurs on replay) advances the stream
+    past the offending window on the second rollback."""
+    root = str(tmp_path / "c")
+    seen = []
+
+    def bad_batch_step(params, opt, batch):
+        p, o, loss = _step_fn(params, opt, batch)
+        marker = float(batch[0, 0])
+        seen.append(marker)
+        if abs(marker - float(_batch_fn(7)[0, 0])) < 1e-12:
+            loss = float("nan")  # batch 7 is poison, every time
+        return p, o, loss
+
+    res = run_resilient(
+        manager=CheckpointManager(root),
+        anomaly=AnomalyPolicy(threshold=1),
+        **_run_kwargs(step_fn=bad_batch_step),
+    )
+    assert res.status == "completed"
+    assert res.rollbacks == 2  # replay first, then skip
+    # the poison batch was seen exactly twice (original + one replay)
+    poison = float(_batch_fn(7)[0, 0])
+    assert sum(1 for m in seen if abs(m - poison) < 1e-12) == 2
+
+
+def test_rollback_cap_gives_up(tmp_path):
+    root = str(tmp_path / "c")
+
+    def nan_after_3(params, opt, batch):
+        p, o, loss = _step_fn(params, opt, batch)
+        if int(o["m"][0]) >= 4:  # steps 3+ always NaN, even on replay/skip
+            loss = float("nan")
+        return p, o, loss
+
+    with pytest.raises(RuntimeError, match="max_rollbacks"):
+        run_resilient(
+            manager=CheckpointManager(root),
+            anomaly=AnomalyPolicy(threshold=1, max_rollbacks=2),
+            **_run_kwargs(step_fn=nan_after_3, save_every=1),
+        )
+
+
+def test_anomaly_without_checkpoint_is_fatal(tmp_path):
+    root = str(tmp_path / "c")
+
+    def always_nan(params, opt, batch):
+        p, o, _ = _step_fn(params, opt, batch)
+        return p, o, float("nan")
+
+    with pytest.raises(RuntimeError, match="no committed checkpoint"):
+        run_resilient(
+            manager=CheckpointManager(root),
+            anomaly=AnomalyPolicy(threshold=1),
+            **_run_kwargs(step_fn=always_nan),
+        )
+
+
+# ========================================================== restart path
+def test_injected_oom_restart_bit_exact(tmp_path):
+    ref = _reference(tmp_path)
+    root = str(tmp_path / "c")
+    faultsim.arm([Fault("oom", at_step=7)])
+    res = run_resilient(
+        manager=CheckpointManager(root), restart_backoff=0.001, **_run_kwargs()
+    )
+    faultsim.disarm()
+    assert res.status == "completed" and res.restarts == 1
+    np.testing.assert_array_equal(res.params["w"], ref.params["w"])
+
+
+def test_restart_budget_exhausted_raises(tmp_path):
+    root = str(tmp_path / "c")
+    faultsim.arm([Fault("oom", at_step=5, count=10**6)])
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        run_resilient(
+            manager=CheckpointManager(root),
+            max_restarts=2,
+            restart_backoff=0.001,
+            **_run_kwargs(),
+        )
+    inj = faultsim.get_injector()
+    assert inj.fired_total["oom"] == 3  # initial + 2 restarts
+
+
+def test_loader_hard_failure_rides_restart_path(tmp_path, token_file, monkeypatch):
+    """Batch fetch failures (retries exhausted) recover like step
+    exceptions: restore from the last checkpoint and replay."""
+    monkeypatch.setenv("VESCALE_LOADER_RETRIES", "2")
+    reset_default_policies()
+
+    def tok_step(params, opt, batch):
+        w = params["w"] + batch["input"].mean(axis=0)[:4].astype(np.float32) * 1e-4
+        return {"w": w}, {"m": opt["m"] + 1}, float(np.abs(w).sum())
+
+    kw = dict(_run_kwargs(step_fn=tok_step, restart_backoff=0.001), batch_fn=None)
+    ref_loader = TokenDataLoader(token_file, batch=2, seq_len=16, seed=11)
+    ref = run_resilient(manager=CheckpointManager(str(tmp_path / "r")),
+                        loader=ref_loader, **kw)
+    ref_loader.close()
+
+    # both retry attempts of one fetch fail -> hard failure -> restart
+    faultsim.arm([Fault("loader_next", at_call=6, count=2)])
+    l1 = TokenDataLoader(token_file, batch=2, seq_len=16, seed=11)
+    res = run_resilient(manager=CheckpointManager(str(tmp_path / "c")),
+                        loader=l1, **kw)
+    faultsim.disarm()
+    l1.close()
+    assert res.status == "completed" and res.restarts == 1
+    np.testing.assert_array_equal(res.params["w"], ref.params["w"])
+
+
+def test_preempt_mid_anomaly_streak_skips_emergency_save(tmp_path):
+    """A SIGTERM landing mid-NaN-streak must not checkpoint the possibly
+    poisoned params — resume replays from the last good save instead."""
+    root = str(tmp_path / "c")
+    faultsim.arm([Fault("nonfinite_loss", at_step=7, count=3),
+                  Fault("preempt", at_step=8)])
+    res = run_resilient(
+        manager=CheckpointManager(root),
+        anomaly=AnomalyPolicy(threshold=5),
+        **_run_kwargs(),
+    )
+    faultsim.disarm()
+    assert res.status == "preempted"
+    assert res.emergency_save_step is None
+    assert CheckpointManager(root).latest_step() == 5  # last clean save
+
+
+def test_restart_without_checkpoint_is_fatal(tmp_path):
+    root = str(tmp_path / "c")
+    faultsim.arm([Fault("oom", at_step=1)])  # before the first save (step 2)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        run_resilient(manager=CheckpointManager(root), **_run_kwargs())
+
+
+def test_keyboard_interrupt_mid_step_resumes_sample_exact(tmp_path):
+    """Ctrl-C raised inside the step (after the batch was fetched) rewinds
+    the data cursor before the emergency save — resume must not skip a
+    sample."""
+    ref = _reference(tmp_path)
+    root = str(tmp_path / "c")
+    fired = []
+
+    def interrupting(params, opt, batch):
+        if not fired and float(np.abs(opt["m"]).sum()) >= 7 * 4:  # step 7
+            fired.append(1)
+            raise KeyboardInterrupt
+        return _step_fn(params, opt, batch)
+
+    res = run_resilient(manager=CheckpointManager(root),
+                        **_run_kwargs(step_fn=interrupting))
+    assert res.status == "preempted" and res.step == 6
+    res2 = run_resilient(manager=CheckpointManager(root), **_run_kwargs())
+    np.testing.assert_array_equal(res2.params["w"], ref.params["w"])
+    assert res2.losses[11] == ref.losses[11]
+
+
+def test_schema_mismatch_refuses_to_quarantine(tmp_path):
+    """A manual-loop checkpoint (no 'extra' tree) is structurally
+    incompatible, not corrupt: run_resilient must refuse, not quarantine
+    every good save and restart from scratch."""
+    root = str(tmp_path / "c")
+    mgr = CheckpointManager(root, keep=3)
+    mgr.save(5, {"model": {"w": np.ones(4, np.float32)},
+                 "optimizer": {"m": np.ones(4, np.float32)}})
+    with pytest.raises(RuntimeError, match="state schema"):
+        run_resilient(manager=CheckpointManager(root), **_run_kwargs())
+    assert not glob.glob(os.path.join(root, "*.corrupt"))
+    assert CheckpointManager(root).latest_step() == 5  # untouched
+
+
+def test_restart_with_all_checkpoints_quarantined_raises(tmp_path):
+    """A step exception whose restore quarantines every checkpoint must
+    raise, not silently continue on un-rewound state."""
+    root = str(tmp_path / "c")
+
+    class FailingRestoreManager(CheckpointManager):
+        def restore(self, *a, **kw):
+            raise OSError("disk went away")
+
+    faultsim.arm([Fault("oom", at_step=4)])
+    with pytest.raises(RuntimeError, match="no checkpoint survived"):
+        run_resilient(manager=FailingRestoreManager(root),
+                      restart_backoff=0.001, **_run_kwargs())
+
+
+def test_closed_loader_fails_fast(token_file):
+    a = TokenDataLoader(token_file, batch=2, seq_len=16, seed=1)
+    a.close()
+    import time as _time
+
+    t0 = _time.perf_counter()
+    with pytest.raises(RuntimeError, match="closed"):
+        a.next()
+    assert _time.perf_counter() - t0 < 0.05  # no retry backoff burned
+
+
+def test_retry_attempt_timeout_thread_per_attempt():
+    """A hung attempt times out without starving later attempts (no shared
+    pool), and the retry succeeds once the op stops hanging."""
+    import time as _time
+
+    calls = []
+
+    def sometimes_hangs():
+        calls.append(1)
+        if len(calls) <= 2:
+            _time.sleep(2.0)  # "hung" well past the timeout
+        return "ok"
+
+    p = RetryPolicy(max_attempts=4, base_backoff=0.0, attempt_timeout=0.1)
+    t0 = _time.perf_counter()
+    assert p.call(sometimes_hangs) == "ok"
+    assert _time.perf_counter() - t0 < 1.5  # two timeouts + one clean run
+    assert len(calls) == 3
+
+
+# ======================================================= loader-fed loop
+def test_run_resilient_with_token_loader_preempt_resume(tmp_path, token_file):
+    def loader():
+        return TokenDataLoader(token_file, batch=2, seq_len=16, seed=11)
+
+    def tok_step(params, opt, batch):
+        w = params["w"] + batch["input"].mean(axis=0)[:4].astype(np.float32) * 1e-4
+        return {"w": w}, {"m": opt["m"] + 1}, float(np.abs(w).sum())
+
+    kw = dict(_run_kwargs(step_fn=tok_step), batch_fn=None)
+    ref_loader = loader()
+    ref = run_resilient(manager=CheckpointManager(str(tmp_path / "r")),
+                        loader=ref_loader, **kw)
+    ref_loader.close()
+
+    faultsim.arm([Fault("preempt", at_step=5)])
+    l1 = loader()
+    r1 = run_resilient(manager=CheckpointManager(str(tmp_path / "c")), loader=l1, **kw)
+    faultsim.disarm()
+    l1.close()
+    assert r1.status == "preempted"
+    l2 = loader()  # fresh process: loader restarts from its checkpointed state
+    r2 = run_resilient(manager=CheckpointManager(str(tmp_path / "c")), loader=l2, **kw)
+    l2.close()
+    assert r2.status == "completed"
+    np.testing.assert_array_equal(r2.params["w"], ref.params["w"])
+    assert r2.losses[11] == ref.losses[11]
+
+
+def test_async_saves_drained_on_completion_and_preemption(tmp_path):
+    ref = _reference(tmp_path)
+    root = str(tmp_path / "c")
+    res = run_resilient(manager=CheckpointManager(root),
+                        **_run_kwargs(async_save=True))
+    assert res.status == "completed"
+    assert CheckpointManager(root).latest_step() == 11  # final save committed
+    root2 = str(tmp_path / "c2")
+    faultsim.arm([Fault("preempt", at_step=7)])
+    r1 = run_resilient(manager=CheckpointManager(root2),
+                       **_run_kwargs(async_save=True))
+    faultsim.disarm()
+    assert r1.status == "preempted" and CheckpointManager(root2).latest_step() == 6
+    r2 = run_resilient(manager=CheckpointManager(root2),
+                       **_run_kwargs(async_save=True))
+    np.testing.assert_array_equal(r2.params["w"], ref.params["w"])
+
+
+# ============================================================== telemetry
+def test_resilience_metrics_and_events(tmp_path):
+    from vescale_tpu import telemetry
+    from vescale_tpu.telemetry.exporters import parse_prometheus_text
+
+    out = str(tmp_path / "tel")
+    telemetry.init(out_dir=out, memtrack=False)
+    try:
+        root = str(tmp_path / "c")
+        faultsim.arm([Fault("nonfinite_loss", at_step=7, count=2),
+                      Fault("storage_write", at_call=0),
+                      Fault("preempt", at_step=10)])
+        res = run_resilient(
+            manager=CheckpointManager(root),
+            anomaly=AnomalyPolicy(threshold=2),
+            **_run_kwargs(),
+        )
+        assert res.status == "preempted"
+        reg = telemetry.get_registry()
+        snap = reg.snapshot()["counters"]
+        assert snap.get("resilience_rollbacks_total") == 1
+        assert snap.get("resilience_anomaly_steps_total") == 2
+        assert snap.get("resilience_preemptions_total") == 1
+        assert snap.get("resilience_io_retries_total", 0) >= 1
+        assert snap.get("resilience_faults_injected_total", 0) >= 4
+        # prometheus carries the series; dashboard renders the block
+        prom = parse_prometheus_text(telemetry.prometheus_dump())
+        assert prom.get("resilience_rollbacks_total") == 1
+        dash = telemetry.dashboard()
+        assert "resilience:" in dash and "resilience_rollbacks_total" in dash
+        # generic counters section must not duplicate resilience names
+        counters_sec = dash.split("resilience:")[0]
+        assert "resilience_rollbacks_total" not in counters_sec
+        # event lines landed in steps.jsonl
+        events = [json.loads(l) for l in open(os.path.join(out, "steps.jsonl"))
+                  if '"event"' in l]
+        kinds = {e["event"] for e in events}
+        assert {"resilience_rollback", "resilience_preempted"} <= kinds
+    finally:
+        faultsim.disarm()
+        telemetry.shutdown()
+
+
+def test_record_event_noop_when_dormant():
+    from vescale_tpu import telemetry
+
+    assert telemetry.record_event("resilience_test", x=1) is None
+
+
+# ------------------------------------------------------------- smoke (CI)
+def test_resilience_smoke_script():
+    """tier-1 wiring of scripts/resilience_smoke.py (the acceptance run)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "resilience_smoke.py")],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, f"smoke failed:\n{proc.stdout}\n{proc.stderr}"

@@ -47,6 +47,7 @@ from . import collectives
 #   vescale_tpu.pipe        (pipeline parallel)  + vescale_tpu.plan
 #   vescale_tpu.moe         (expert parallel)
 #   vescale_tpu.checkpoint  (distributed save/load + reshard)
+#   vescale_tpu.resilience  (fault injection / retry / preemption / recovery loop)
 #   vescale_tpu.ndtimeline  (profiler)
 #   vescale_tpu.telemetry   (metrics registry / step reports / exporters)
 #   vescale_tpu.emulator    (bitwise collective replay)

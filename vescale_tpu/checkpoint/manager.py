@@ -4,17 +4,23 @@ Capability parity with the reference VeScaleCheckpointer
 (legacy/vescale/checkpoint/api/vescale_checkpointer.py:71): the trainer-facing
 wrapper that names checkpoints by step, keeps the last K, and on restart
 finds the newest COMMITTED one (a dir whose ``meta.json`` commit marker
-exists — a torn save from a crashed run is invisible, __init__.py commit
-protocol).  The MegaScale-style recovery loop (checkpoint/README.md:49):
+exists AND parses — a torn save from a crashed run is invisible,
+__init__.py commit protocol).  The MegaScale-style recovery loop is
+packaged as ``vescale_tpu.resilience.run_resilient`` (resilience/loop.py),
+which composes this manager with the data loader's resume state, the
+preemption handler and the anomaly guard:
+
+    from vescale_tpu.resilience import run_resilient
 
     mgr = CheckpointManager("gs-or-fs/ckpts", keep=3)
-    step = mgr.latest_step()            # None when nothing is restorable
-    state = (mgr.restore({"model": tmpl, "optimizer": opt_tmpl})
-             if step is not None else init())
-    for i in count(step + 1 if step is not None else 0):
-        ...train...
-        if i % 1000 == 0:
-            mgr.save(i, {"model": params, "optimizer": opt}, async_checkpoint=True)
+    result = run_resilient(
+        step_fn=step, params=params, opt_state=opt_state,
+        manager=mgr, loader=loader, total_steps=40_000, save_every=1000,
+    )   # auto-resumes from the newest committed step, quarantines corrupt
+        # ones, emergency-saves on SIGTERM, rolls back on NaN bursts
+
+(The manual loop — latest_step()/restore()/save() — still works; see
+docs/checkpoint.md.)
 
 Contract: ONE CheckpointManager instance owns a root per process (the
 reference checkpointer's assumption too).  Saves issued behind the
@@ -24,6 +30,7 @@ be tracked, so rollback pruning cannot wait them out.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import shutil
@@ -48,6 +55,11 @@ class CheckpointManager:
         self.root = root
         self.keep = int(keep)
         os.makedirs(root, exist_ok=True)
+        # meta.json validation cache: (size, mtime_ns) of metas that parsed
+        # (committed metas are immutable; resave/uncommit delete the file,
+        # changing the key) — _committed_steps runs per save for rotation
+        # and must not re-parse every meta every time
+        self._meta_ok: Dict[str, tuple] = {}
         # highest step save() was ever asked for, seeded from disk so a
         # RESTARTED process that resumes from an older step still recognizes
         # the on-disk newer steps as stale futures when it next saves
@@ -65,6 +77,29 @@ class CheckpointManager:
     def step_path(self, step: int) -> str:
         return os.path.join(self.root, f"step_{step:010d}")
 
+    def _meta_committed(self, meta_path: str) -> bool:
+        """True iff the commit marker is a real one: present, non-empty AND
+        JSON-parseable.  A crash mid-commit-write (non-atomic storage, power
+        loss before the data hit disk) can leave a zero-byte or truncated
+        meta.json — counting that as committed makes restore() fail on a
+        checkpoint that never finished (the torn-commit false positive)."""
+        try:
+            st = os.stat(meta_path)
+        except OSError:
+            return False
+        if st.st_size == 0:
+            return False
+        key = (st.st_size, st.st_mtime_ns)
+        if self._meta_ok.get(meta_path) == key:
+            return True
+        try:
+            with open(meta_path, "rb") as f:
+                json.loads(f.read().decode())
+        except (OSError, ValueError, UnicodeDecodeError):
+            return False
+        self._meta_ok[meta_path] = key
+        return True
+
     def _committed_steps(self) -> List[int]:
         out = []
         try:
@@ -73,7 +108,7 @@ class CheckpointManager:
             return out
         for e in entries:
             m = _STEP_RE.match(e)
-            if m and os.path.exists(os.path.join(self.root, e, "meta.json")):
+            if m and self._meta_committed(os.path.join(self.root, e, "meta.json")):
                 out.append(int(m.group(1)))
         return sorted(out)
 
@@ -98,6 +133,7 @@ class CheckpointManager:
         if jax.process_index() != 0:
             return
         step_dir = self.step_path(step)
+        self._meta_ok.pop(os.path.join(step_dir, "meta.json"), None)
         try:
             os.remove(os.path.join(step_dir, "meta.json"))
         except OSError:
@@ -107,10 +143,63 @@ class CheckpointManager:
         self._fsync_dir(self.root)
 
     def latest_step(self) -> Optional[int]:
-        """Newest step with a COMMITTED checkpoint (meta.json present);
-        None if nothing is restorable."""
+        """Newest step with a COMMITTED checkpoint (meta.json present and
+        parseable); None if nothing is restorable."""
         steps = self._committed_steps()
         return steps[-1] if steps else None
+
+    def quarantine(self, step: int) -> Optional[str]:
+        """Sideline a committed-but-unloadable step: rename its dir to
+        ``step_<N>.corrupt`` so ``latest_step`` skips it (the restore-time
+        fallback of resilience/loop.py retries the next-older committed
+        step) while the bytes stay on disk for forensics.  Returns the
+        quarantine path, or None when the dir is already gone.  Process 0
+        renames; in multi-process runs the built-in barrier holds everyone
+        until the rename landed (all processes must call this on the
+        shared restore failure)."""
+        step_dir = self.step_path(step)
+        dst = step_dir + ".corrupt"
+        self._meta_ok.pop(os.path.join(step_dir, "meta.json"), None)
+        self._known_steps.discard(step)
+        renamed = True
+        if jax.process_index() == 0:
+            if os.path.exists(dst):  # a previous quarantine of this step
+                shutil.rmtree(dst, ignore_errors=True)
+            try:
+                os.rename(step_dir, dst)
+            except OSError:
+                renamed = False
+            self._fsync_dir(self.root)
+        if jax.process_count() > 1:
+            # every process calls quarantine on the shared restore failure;
+            # nobody may re-list the root (and retry the same step, issuing
+            # mismatched collective loads) until process 0's rename landed
+            from ..distributed import barrier
+
+            barrier(f"ckpt_quarantine:{step}")
+        if not renamed:
+            return None
+        from .. import telemetry as _tel
+
+        _tel.count("resilience_quarantined_total")
+        return dst
+
+    def wait_pending(self) -> None:
+        """Drain every in-flight async save: failed ones are joined without
+        committing, live ones are ``wait()``ed (committing them).  The
+        preemption path calls this before the emergency synchronous save so
+        no io worker is still writing when the process exits."""
+        pending, self._pending = self._pending, {}
+        for s in sorted(pending):
+            h = pending[s]
+            if h.failed:
+                h.drain()
+                continue
+            try:
+                h.wait()
+            except Exception:
+                pass  # the failed step never commits; the emergency save matters
+            h.drain()
 
     # -------------------------------------------------------------- save
     def save(

@@ -45,6 +45,15 @@ class Storage:
 
 
 class FileSystemStorage(Storage):
+    """Durable filesystem backend.  Reads and writes route through the
+    resilience retry policy (``VESCALE_CKPT_RETRIES`` /
+    ``VESCALE_IO_BACKOFF_*`` — resilience/retry.py) and the faultsim
+    ``storage_write``/``storage_read`` hooks, so transient ``OSError``s are
+    absorbed with backoff and injectable in tests.  NOTE: chunk writes that
+    ride the native C++ pool (AsyncWriter) bypass this method — tests that
+    inject write faults set ``VESCALE_NATIVE_CKPT_IO=0``; the commit marker
+    (meta.json) always goes through here."""
+
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
@@ -54,11 +63,14 @@ class FileSystemStorage(Storage):
         os.makedirs(os.path.dirname(p), exist_ok=True)
         return p
 
-    def write_bytes(self, name: str, data: bytes) -> None:
+    def _write_once(self, name: str, data: bytes) -> None:
         # fsync BEFORE the rename and fsync the parent dir after: the rename
         # is the commit point, and the commit protocol (meta.json chases
         # durable chunks) is void if a power loss can persist the name
         # without the bytes (or drop the directory entry)
+        from ..resilience import faultsim as _fs
+
+        _fs.check("storage_write", ctx=name)
         path = self._p(name)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
@@ -72,9 +84,22 @@ class FileSystemStorage(Storage):
         finally:
             os.close(dfd)
 
-    def read_bytes(self, name: str) -> bytes:
+    def write_bytes(self, name: str, data: bytes) -> None:
+        from ..resilience.retry import ckpt_policy
+
+        ckpt_policy().call(self._write_once, name, data, description=name)
+
+    def _read_once(self, name: str) -> bytes:
+        from ..resilience import faultsim as _fs
+
+        _fs.check("storage_read", ctx=name)
         with open(os.path.join(self.root, name), "rb") as f:
             return f.read()
+
+    def read_bytes(self, name: str) -> bytes:
+        from ..resilience.retry import ckpt_policy
+
+        return ckpt_policy().call(self._read_once, name, description=name)
 
     def exists(self, name: str) -> bool:
         return os.path.exists(os.path.join(self.root, name))

@@ -6,6 +6,18 @@ mmap + prefetch-thread loader (data/native/dataloader.cpp) keeps the host
 input path off the TPU step's critical path.  DP sharding: each dp rank
 draws a disjoint deterministic stream, so batches differ across dp while
 runs reproduce exactly (seed-stable SplitMix64).
+
+Resilience surfaces (resilience/):
+
+  * ``next()`` routes through the retry/backoff policy
+    (``VESCALE_LOADER_RETRIES`` / ``VESCALE_IO_BACKOFF_*``) and the
+    faultsim ``loader_next`` hook, so transient native failures are
+    absorbed and injectable.
+  * ``state()`` / ``load_state()`` — the sample-exact resume contract:
+    batches are a pure function of (seed, dp coords, batch index), so the
+    position is one counter.  Restore fast-forwards via the native
+    ``vdl_seek`` (O(1) — skipped batches are never filled); rewinding
+    reopens the file first (prefetch state cannot run backwards).
 """
 
 from __future__ import annotations
@@ -15,7 +27,7 @@ import os
 import subprocess
 import threading
 import time
-from typing import Optional, Tuple
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -60,6 +72,9 @@ def _lib():
         lib.vdl_num_tokens.argtypes = [ctypes.c_void_p]
         lib.vdl_close.restype = None
         lib.vdl_close.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "vdl_seek"):  # absent only with a stale prebuilt .so
+            lib.vdl_seek.restype = ctypes.c_int
+            lib.vdl_seek.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         _LIB = lib
     return _LIB
 
@@ -88,15 +103,63 @@ class TokenDataLoader:
         if token_bytes not in (2, 4):
             raise ValueError("token dtype must be 2 or 4 bytes")
         self.batch, self.seq_len = batch, seq_len
-        self._h = _lib().vdl_open(
-            path.encode(), token_bytes, seq_len, batch, seed, dp_rank, dp_world, num_prefetch_threads
+        self.path = path
+        self.seed, self.dp_rank, self.dp_world = int(seed), int(dp_rank), int(dp_world)
+        self._token_bytes = token_bytes
+        self._nprefetch = num_prefetch_threads
+        # the lib handle is cached ON the instance: __del__ during
+        # interpreter shutdown must not re-enter build_native()/CDLL (module
+        # globals may already be torn down)
+        self._lib = _lib()
+        self._batches_served = 0  # serve cursor, = next batch index
+        self._close_lock = threading.Lock()  # close() idempotent under races
+        self._h = self._open_native()
+
+    def _open_native(self):
+        h = self._lib.vdl_open(
+            self.path.encode(),
+            self._token_bytes,
+            self.seq_len,
+            self.batch,
+            self.seed,
+            self.dp_rank,
+            self.dp_world,
+            self._nprefetch,
         )
-        if not self._h:
-            raise OSError(f"cannot open token file {path!r} (too small or unreadable)")
+        if not h:
+            raise OSError(f"cannot open token file {self.path!r} (too small or unreadable)")
+        return h
 
     @property
     def num_tokens(self) -> int:
-        return int(_lib().vdl_num_tokens(self._h))
+        return int(self._lib.vdl_num_tokens(self._h))
+
+    @property
+    def batches_served(self) -> int:
+        return self._batches_served
+
+    def _fetch(self) -> dict:
+        """One native batch fetch — the unit the retry policy wraps.  The
+        faultsim hook sits INSIDE so an injected fault consumes one attempt
+        and a clean retry can succeed (transient-failure semantics)."""
+        from ..resilience import faultsim as _fs
+
+        _fs.check("loader_next", ctx=f"batch#{self._batches_served} {self.path}")
+        if self._h is None:
+            raise RuntimeError(f"TokenDataLoader({self.path!r}) is closed")
+        x = np.empty((self.batch, self.seq_len), np.int32)
+        y = np.empty((self.batch, self.seq_len), np.int32)
+        rc = self._lib.vdl_next(
+            self._h,
+            x.ctypes.data_as(ctypes.c_void_p),
+            y.ctypes.data_as(ctypes.c_void_p),
+        )
+        if rc != 0:
+            raise RuntimeError(
+                f"native loader failed: vdl_next rc={rc} "
+                f"(path={self.path!r}, batch_index={self._batches_served})"
+            )
+        return {"input": x, "target": y}
 
     def next(self) -> dict:
         # DATA_LOAD span (VERDICT item 7): the one host-side region of the
@@ -105,32 +168,106 @@ class TokenDataLoader:
         from ..ndtimeline.api import ndtimeit
         from ..ndtimeline.predefined import DATA_LOAD
         from .. import telemetry as _tel
+        from ..resilience.retry import loader_policy
 
+        # closed-loader check BEFORE the retry wrapper: a programming error
+        # must fail fast, not burn retries/backoff as if it were transient
+        if self._h is None:
+            raise RuntimeError(f"TokenDataLoader({self.path!r}) is closed")
         # unconditional stamp (~ns): telemetry flipping on mid-fetch must
         # not observe perf_counter() - 0.0 into the histogram
         t0 = time.perf_counter()
         with ndtimeit(DATA_LOAD):
-            x = np.empty((self.batch, self.seq_len), np.int32)
-            y = np.empty((self.batch, self.seq_len), np.int32)
-            rc = _lib().vdl_next(
+            out = loader_policy().call(
+                self._fetch, description=f"batch#{self._batches_served} of {self.path}"
+            )
+        self._batches_served += 1
+        if _tel.is_active():
+            _tel.observe("data_load_seconds", time.perf_counter() - t0)
+        return out
+
+    # --------------------------------------------------------- resume state
+    def state(self) -> Dict[str, int]:
+        """Checkpointable position: batches are a pure function of
+        (seed, dp_rank, dp_world, batch index), so the stream is one
+        counter plus its identity coords (dp coords are part of the state
+        because restoring rank r's counter into rank q's stream would
+        silently change the data)."""
+        return {
+            "batches_served": int(self._batches_served),
+            "seed": self.seed,
+            "dp_rank": self.dp_rank,
+            "dp_world": self.dp_world,
+            "batch": int(self.batch),
+            "seq_len": int(self.seq_len),
+        }
+
+    def load_state(self, state: Dict[str, int]) -> None:
+        """Position the stream so the next ``next()`` returns batch
+        ``state['batches_served']`` — sample-exact resume.  Forward moves
+        use the native seek (O(1)); backward moves (rollback) reopen the
+        file and seek from zero.  Identity coords must match: a loader
+        built for different dp coords / shape is a DIFFERENT stream."""
+        for key in ("seed", "dp_rank", "dp_world", "batch", "seq_len"):
+            if key in state and int(state[key]) != int(getattr(self, key)):
+                raise ValueError(
+                    f"loader state mismatch on {key!r}: checkpoint has "
+                    f"{state[key]}, this loader has {getattr(self, key)} — "
+                    "resuming would silently change the data stream"
+                )
+        target = int(state["batches_served"])
+        if self._h is None:
+            raise RuntimeError(f"TokenDataLoader({self.path!r}) is closed")
+        if target < self._batches_served:
+            # prefetch cannot run backwards: reopen, then seek forward
+            with self._close_lock:
+                h, self._h = self._h, None
+            if h:
+                self._lib.vdl_close(h)
+            self._h = self._open_native()
+            self._batches_served = 0
+        if target > self._batches_served:
+            self._seek(target)
+        self._batches_served = target
+
+    def _seek(self, target: int) -> None:
+        if hasattr(self._lib, "vdl_seek"):
+            rc = self._lib.vdl_seek(self._h, target)
+            if rc != 0:
+                raise RuntimeError(
+                    f"native loader seek to {target} failed: rc={rc} (path={self.path!r})"
+                )
+            return
+        # stale .so without vdl_seek: drain-and-discard fallback
+        x = np.empty((self.batch, self.seq_len), np.int32)
+        y = np.empty((self.batch, self.seq_len), np.int32)
+        for _ in range(target - self._batches_served):
+            rc = self._lib.vdl_next(
                 self._h,
                 x.ctypes.data_as(ctypes.c_void_p),
                 y.ctypes.data_as(ctypes.c_void_p),
             )
             if rc != 0:
-                raise RuntimeError("native loader failed")
-        if _tel.is_active():
-            _tel.observe("data_load_seconds", time.perf_counter() - t0)
-        return {"input": x, "target": y}
+                raise RuntimeError(
+                    f"native loader failed during fast-forward: vdl_next rc={rc} "
+                    f"(path={self.path!r})"
+                )
 
     def __iter__(self):
         while True:
             yield self.next()
 
     def close(self) -> None:
-        if getattr(self, "_h", None):
-            _lib().vdl_close(self._h)
-            self._h = None
+        # pop the handle under the lock so concurrent close()/close() (or
+        # close racing __del__ at shutdown) frees it exactly once; getattr
+        # guards a __del__ after a failed __init__
+        lock = getattr(self, "_close_lock", None)
+        if lock is None:
+            return
+        with lock:
+            h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.vdl_close(h)
 
     def __del__(self):  # pragma: no cover
         try:

@@ -10,6 +10,7 @@
 // C API (see data/loader.py):
 //   vdl_open(path, token_bytes, seq_len, batch, seed, rank, world, nprefetch)
 //   vdl_next(handle, x_out, y_out)   -> blocks until a batch is ready
+//   vdl_seek(handle, index)          -> forward-seek the serve cursor
 //   vdl_num_tokens(handle)
 //   vdl_close(handle)
 //
@@ -120,10 +121,14 @@ struct Loader {
       fill(b, index);
       std::unique_lock<std::mutex> lk(mu);
       if (stop.load()) return;
-      // unconditional insert: ready may briefly exceed max_ready by up to
-      // the worker count, which is bounded and preserves in-order serving
-      ready.emplace(index, std::move(b));
-      cv_ready.notify_all();
+      // insert unless a seek already moved the cursor past this index (a
+      // stale batch would pile up in `ready` forever); ready may briefly
+      // exceed max_ready by up to the worker count, which is bounded and
+      // preserves in-order serving
+      if (index >= next_serve) {
+        ready.emplace(index, std::move(b));
+        cv_ready.notify_all();
+      }
     }
   }
 };
@@ -191,6 +196,33 @@ int vdl_next(void* handle, int32_t* x_out, int32_t* y_out) {
   }
   std::memcpy(x_out, b.x.data(), b.x.size() * sizeof(int32_t));
   std::memcpy(y_out, b.y.data(), b.y.size() * sizeof(int32_t));
+  return 0;
+}
+
+int vdl_seek(void* handle, uint64_t target) {
+  // Forward-seek the serve cursor to batch `target` (resume fast-forward:
+  // batches are generated independently per index, so skipping is O(1) —
+  // no fill work is owed for the skipped range).  Backward seeks are
+  // rejected; the Python side reopens the loader instead (prefetch state
+  // cannot be rewound).
+  if (!handle) return -1;
+  auto* L = (Loader*)handle;
+  std::unique_lock<std::mutex> lk(L->mu);
+  if (target < L->next_serve) return -2;
+  // drop prefetched batches the seek skips over
+  for (auto it = L->ready.begin(); it != L->ready.end();) {
+    if (it->first < target)
+      it = L->ready.erase(it);
+    else
+      ++it;
+  }
+  L->next_serve = target;
+  // advance the claim counter so workers start filling from `target`; a
+  // worker mid-fill on a stale index is handled by the insert guard above
+  uint64_t cur = L->batch_counter.load();
+  while (cur < target && !L->batch_counter.compare_exchange_weak(cur, target)) {
+  }
+  L->cv_space.notify_all();
   return 0;
 }
 

@@ -1,0 +1,47 @@
+"""vescale_tpu.resilience — fault tolerance for production training runs.
+
+Four pieces, one layer (docs/resilience.md):
+
+  1. **faultsim** (faultsim.py): deterministic, env/config-gated fault
+     injection — storage read/write ``OSError``s, native-loader failures,
+     non-finite loss bursts, simulated preemption, RESOURCE_EXHAUSTED —
+     seeded schedules keyed on step/call-count; zero overhead disarmed
+     (no-op function references, the ``telemetry.init()`` pattern).
+  2. **retry** (retry.py): ``RetryPolicy`` — bounded attempts, exponential
+     backoff + deterministic jitter, optional per-attempt timeout — wired
+     into checkpoint storage and ``TokenDataLoader.next`` via
+     ``VESCALE_CKPT_RETRIES`` / ``VESCALE_IO_BACKOFF_*`` env knobs.
+  3. **preempt** (preempt.py): SIGTERM/SIGINT -> stop flag checked at step
+     boundaries; one emergency synchronous save, clean exit, sample-exact
+     resume (with ``TokenDataLoader.state()``/``load_state()``).
+  4. **loop** (loop.py): ``run_resilient(...)`` — auto-resume from the
+     newest committed checkpoint, corrupt-checkpoint quarantine, anomaly
+     guard (NaN/skip/z-spike -> rollback, replay-then-skip), bounded
+     in-process restarts with backoff.
+
+All recovery events surface as ``resilience_*`` counters in the telemetry
+registry (rendered as the ``resilience:`` dashboard block) and as event
+lines in ``steps.jsonl``.
+"""
+
+from . import faultsim
+from .faultsim import Fault, FaultInjector, arm_from_env, parse_schedule
+from .loop import AnomalyPolicy, RunResult, run_resilient
+from .preempt import PreemptionHandler
+from .retry import RetryPolicy, ckpt_policy, loader_policy, reset_default_policies
+
+__all__ = [
+    "faultsim",
+    "Fault",
+    "FaultInjector",
+    "parse_schedule",
+    "arm_from_env",
+    "RetryPolicy",
+    "ckpt_policy",
+    "loader_policy",
+    "reset_default_policies",
+    "PreemptionHandler",
+    "AnomalyPolicy",
+    "RunResult",
+    "run_resilient",
+]

@@ -1,0 +1,269 @@
+"""Deterministic fault injection — the test substrate of the resilience
+layer.
+
+Production LLM training fails in a handful of well-known ways (PAPER.md
+§L4; MegaScale §5): flaky storage during checkpoint save/restore, a data
+loader hiccup, a burst of non-finite losses after a bad batch or a flipped
+bit, a scheduler preemption, and device-memory exhaustion.  None of these
+can be waited for in CI — this module *schedules* them.
+
+A fault fires at a deterministic point keyed on the per-kind **call
+counter** (the Nth time the hook is consulted) or on the **training step**
+(as published by ``set_step``), optionally repeating ``count`` consecutive
+times; a seeded-probability mode (``p`` + ``seed``) hashes the call index
+so even "random" faults replay exactly.  The schedule comes from
+``arm([...])`` in tests or the ``VESCALE_FAULTSIM`` env var in scripted
+runs:
+
+    VESCALE_FAULTSIM="storage_write:call=3;preempt:step=10;nonfinite_loss:step=6,count=4"
+
+Grammar: ``kind:key=value[,key=value...]`` joined by ``;`` where keys are
+``call`` (0-based per-kind call index), ``step``, ``count`` (default 1),
+``p`` (probability per call) and ``seed``.
+
+Fault kinds and their hook sites:
+
+  ================  ====================================================
+  kind              raised / observed at
+  ----------------  ----------------------------------------------------
+  storage_write     ``OSError`` from ``FileSystemStorage.write_bytes``
+  storage_read      ``OSError`` from ``FileSystemStorage.read_bytes``
+  loader_next       native-loader failure in ``TokenDataLoader.next``
+  nonfinite_loss    observed by ``run_resilient`` — the step's loss reads
+                    as NaN to the anomaly guard (the compiled program is
+                    untouched; real NaNs come from hardware)
+  preempt           sets the run's preemption stop flag (as if SIGTERM)
+  oom               ``RuntimeError("RESOURCE_EXHAUSTED...")`` around the
+                    train step (exercises flight recorder + restart path)
+  ================  ====================================================
+
+Gating contract (the ``telemetry.init()`` pattern): while disarmed the
+module hooks ``check`` / ``fires`` ARE the no-op function references
+``_noop_check`` / ``_noop_fires`` (tests assert identity) — a production
+run pays one attribute access + call per hook site and nothing else.
+Callers must use ``faultsim.check(...)`` attribute access, never
+``from faultsim import check`` (which would freeze the disarmed binding).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = [
+    "KINDS",
+    "Fault",
+    "FaultInjector",
+    "arm",
+    "disarm",
+    "is_armed",
+    "get_injector",
+    "parse_schedule",
+    "arm_from_env",
+    "check",
+    "fires",
+    "set_step",
+]
+
+KINDS = (
+    "storage_write",
+    "storage_read",
+    "loader_next",
+    "nonfinite_loss",
+    "preempt",
+    "oom",
+)
+
+# errors raised by `check` per kind; observation-level kinds (nonfinite_loss,
+# preempt) never raise — callers use `fires` and act on the bool
+_RAISES = {
+    "storage_write": lambda ctx: OSError(f"[faultsim] injected storage write failure ({ctx})"),
+    "storage_read": lambda ctx: OSError(f"[faultsim] injected storage read failure ({ctx})"),
+    "loader_next": lambda ctx: RuntimeError(f"[faultsim] injected native loader failure ({ctx})"),
+    "oom": lambda ctx: RuntimeError(
+        f"RESOURCE_EXHAUSTED: [faultsim] injected out-of-memory ({ctx})"
+    ),
+}
+
+
+def _splitmix64(x: int) -> int:
+    """Deterministic 64-bit mix (the data loader's SplitMix64 finalizer) —
+    the seeded-probability mode must replay bit-exactly across runs."""
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
+
+
+@dataclass
+class Fault:
+    """One scheduled fault.  Exactly one trigger: ``at_call`` (0-based
+    per-kind call index), ``at_step`` (training step, via ``set_step``), or
+    ``p`` (seeded per-call probability).  ``count`` consecutive firings."""
+
+    kind: str
+    at_call: Optional[int] = None
+    at_step: Optional[int] = None
+    p: float = 0.0
+    seed: int = 0
+    count: int = 1
+    fired: int = field(default=0, init=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} (one of {KINDS})")
+        triggers = (self.at_call is not None) + (self.at_step is not None) + (self.p > 0)
+        if triggers != 1:
+            raise ValueError(
+                f"fault {self.kind}: exactly one trigger of call/step/p required"
+            )
+
+    def should_fire(self, call_index: int, step: Optional[int]) -> bool:
+        # a fault fires at most `count` times TOTAL: a step-keyed fault that
+        # re-fired when the recovery loop replays the same step would make
+        # every rollback loop forever (transient-fault semantics)
+        if self.fired >= self.count:
+            return False
+        if self.at_call is not None:
+            return self.at_call <= call_index < self.at_call + self.count
+        if self.at_step is not None:
+            return step is not None and self.at_step <= step < self.at_step + self.count
+        # seeded probability: hash (seed, kind, call index) to a replayable
+        # coin — crc32, not hash() (str hashing is salted per process)
+        h = _splitmix64(
+            self.seed * 1000003 + zlib.crc32(self.kind.encode()) + call_index * 2654435761
+        )
+        return (h / 2.0**64) < self.p
+
+
+class FaultInjector:
+    """Live schedule state: per-kind call counters + the current step.
+    Exists only between ``arm`` and ``disarm`` — its absence IS off."""
+
+    def __init__(self, faults: List[Fault]):
+        self.faults = list(faults)
+        self.calls: Dict[str, int] = {k: 0 for k in KINDS}
+        self.fired_total: Dict[str, int] = {k: 0 for k in KINDS}
+        self.step: Optional[int] = None
+        self._lock = threading.Lock()  # storage hooks run on io pool threads
+
+    def _consult(self, kind: str, ctx: str) -> bool:
+        with self._lock:
+            idx = self.calls[kind]
+            self.calls[kind] = idx + 1
+            hit = False
+            for f in self.faults:
+                if f.kind == kind and f.should_fire(idx, self.step):
+                    f.fired += 1
+                    hit = True
+            if hit:
+                self.fired_total[kind] += 1
+        if hit:
+            from .. import telemetry as _tel
+
+            _tel.count("resilience_faults_injected_total")
+        return hit
+
+    # --------------------------------------------------------- live hooks
+    def check(self, kind: str, ctx: str = "") -> None:
+        """Raise the kind's injected error if a fault is due (raising
+        kinds), else return None.  Observation kinds never raise here."""
+        if self._consult(kind, ctx) and kind in _RAISES:
+            raise _RAISES[kind](ctx or f"call#{self.calls[kind] - 1}")
+
+    def fires(self, kind: str, ctx: str = "") -> bool:
+        """Consume one call slot and report whether a fault fires —
+        the non-raising twin of ``check`` for observation-level kinds."""
+        return self._consult(kind, ctx)
+
+    def set_step(self, step: int) -> None:
+        self.step = int(step)
+
+
+# ----------------------------------------------------------- disarmed hooks
+def _noop_check(kind: str, ctx: str = "") -> None:
+    return None
+
+
+def _noop_fires(kind: str, ctx: str = "") -> bool:
+    return False
+
+
+def _noop_set_step(step: int) -> None:
+    return None
+
+
+check = _noop_check
+fires = _noop_fires
+set_step = _noop_set_step
+
+_INJECTOR: Optional[FaultInjector] = None
+
+
+def is_armed() -> bool:
+    return _INJECTOR is not None
+
+
+def get_injector() -> Optional[FaultInjector]:
+    return _INJECTOR
+
+
+def arm(faults: List[Fault]) -> FaultInjector:
+    """Install a fault schedule and rebind the live hooks.  Re-arming
+    replaces the previous schedule (counters restart at zero)."""
+    global _INJECTOR, check, fires, set_step
+    _INJECTOR = FaultInjector(faults)
+    check = _INJECTOR.check
+    fires = _INJECTOR.fires
+    set_step = _INJECTOR.set_step
+    return _INJECTOR
+
+
+def disarm() -> None:
+    """Drop the schedule and restore the no-op hook references."""
+    global _INJECTOR, check, fires, set_step
+    _INJECTOR = None
+    check = _noop_check
+    fires = _noop_fires
+    set_step = _noop_set_step
+
+
+def parse_schedule(text: str) -> List[Fault]:
+    """Parse the ``VESCALE_FAULTSIM`` grammar (module docstring)."""
+    faults: List[Fault] = []
+    for part in text.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, argstr = part.partition(":")
+        kind = kind.strip()
+        kwargs: Dict[str, float] = {}
+        if argstr:
+            for kv in argstr.split(","):
+                k, _, v = kv.partition("=")
+                k = k.strip()
+                if k not in ("call", "step", "count", "p", "seed"):
+                    raise ValueError(f"faultsim spec {part!r}: unknown key {k!r}")
+                kwargs[k] = float(v) if k == "p" else int(v)
+        faults.append(
+            Fault(
+                kind,
+                at_call=int(kwargs["call"]) if "call" in kwargs else None,
+                at_step=int(kwargs["step"]) if "step" in kwargs else None,
+                p=float(kwargs.get("p", 0.0)),
+                seed=int(kwargs.get("seed", 0)),
+                count=int(kwargs.get("count", 1)),
+            )
+        )
+    return faults
+
+
+def arm_from_env(var: str = "VESCALE_FAULTSIM") -> Optional[FaultInjector]:
+    """Arm from the env schedule if set (scripted runs); None otherwise."""
+    text = os.environ.get(var)
+    if not text:
+        return None
+    return arm(parse_schedule(text))

@@ -1,0 +1,447 @@
+"""run_resilient — the auto-recovering train loop.
+
+The layer that composes the repo's fault-tolerance ingredients into a run
+that actually survives the real world (PAPER.md §L4's reason to exist —
+the MegaScale-style recovery loop the checkpoint layer was built to make
+cheap): commit-protocol checkpoints (checkpoint/manager.py), sample-exact
+loader resume (data/loader.py ``state``/``load_state``), retry/backoff
+I/O (resilience/retry.py), preemption handling (resilience/preempt.py),
+the optimizer's skip-on-nonfinite signal, and the OOM flight recorder
+(telemetry/memtrack.py).  Failure playbook:
+
+  crash / restart        auto-resume from the newest COMMITTED checkpoint:
+                         params, optimizer state, RNG stream, loader
+                         position, step counter — all one unit.
+  corrupt latest ckpt    quarantined (``step_N.corrupt``) and the
+                         next-older committed step is tried — a bad disk
+                         block costs one checkpoint interval, not the run.
+  SIGTERM / SIGINT       stop flag checked at the step boundary: drain
+                         in-flight async saves, one emergency SYNCHRONOUS
+                         save, clean return (status="preempted").
+  NaN / loss-spike burst after ``threshold`` consecutive anomalous steps
+                         (non-finite loss, optimizer skip, or z-score
+                         spike) roll back to the last good checkpoint and
+                         REPLAY (transient faults vanish); if the same
+                         window goes bad twice, skip its data (bad batch).
+  step exception         (RESOURCE_EXHAUSTED, loader hard-failure, ...)
+                         flight-record, back off, restore, retry — up to
+                         ``max_restarts`` in-process restarts.
+
+Every recovery event surfaces as a ``resilience_*`` counter in the
+telemetry registry (exporters render them as the ``resilience:`` dashboard
+block) and as an event line in ``steps.jsonl``.
+
+Determinism contract: with a seeded loader (or a pure ``batch_fn``) and a
+deterministic step, a run that suffers any schedule of transient faults
+finishes BIT-IDENTICAL to an uninterrupted run — replay recomputes the
+same program on the same data from checkpoint-roundtripped state
+(scripts/resilience_smoke.py asserts this end to end).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from . import faultsim as _fs
+from .preempt import PreemptionHandler
+
+__all__ = ["AnomalyPolicy", "RunResult", "run_resilient"]
+
+
+@dataclass
+class AnomalyPolicy:
+    """When does a sequence of suspicious steps become a rollback?
+
+    A step is ANOMALOUS when its loss is non-finite, the optimizer's
+    dynamic-loss-scale machinery skipped it (``skip_count`` > 0 in the
+    opt state), or its loss z-scores beyond ``zscore`` against the rolling
+    window of the last ``window`` clean losses (only once ``min_history``
+    of them exist — early training is spiky by nature).  ``threshold``
+    consecutive anomalous steps trigger the rollback."""
+
+    threshold: int = 3
+    zscore: float = 0.0  # 0 disables spike detection (NaN/skip still armed)
+    window: int = 64
+    min_history: int = 16
+    max_rollbacks: int = 8
+
+
+@dataclass
+class RunResult:
+    params: Any
+    opt_state: Any
+    step: int  # last COMPLETED step (-1: none)
+    status: str  # "completed" | "preempted"
+    restarts: int = 0
+    rollbacks: int = 0
+    quarantined: int = 0
+    anomaly_steps: int = 0
+    emergency_save_step: Optional[int] = None
+    losses: Dict[int, float] = field(default_factory=dict)  # last-run window
+
+
+def _skip_count(opt_state) -> int:
+    """The optimizer's consecutive-skipped-step counter, when it has one
+    (DistributedOptimizer with loss_scale='dynamic'); 0 otherwise."""
+    if isinstance(opt_state, dict):
+        ls = opt_state.get("loss_scale")
+        if isinstance(ls, dict) and "skip_count" in ls:
+            try:
+                return int(ls["skip_count"])
+            except (TypeError, ValueError):
+                return 0
+    return 0
+
+
+def run_resilient(
+    *,
+    step_fn: Callable,
+    params: Any,
+    opt_state: Any,
+    manager,
+    total_steps: int,
+    loader=None,
+    batch_fn: Optional[Callable[[int], Any]] = None,
+    save_every: int = 100,
+    async_save: bool = True,
+    rng_seed: Optional[int] = None,
+    anomaly: Optional[AnomalyPolicy] = None,
+    max_restarts: int = 3,
+    restart_backoff: float = 0.5,
+    preemption: Optional[PreemptionHandler] = None,
+    install_signal_handlers: bool = True,
+    on_step: Optional[Callable[[int, float], None]] = None,
+) -> RunResult:
+    """Run ``total_steps`` training steps with automatic recovery.
+
+    ``step_fn(params, opt_state, batch[, step_key]) -> (params, opt_state,
+    loss, ...)`` — a ``make_train_step`` product or anything
+    signature-compatible.  Data comes from ``loader`` (a ``TokenDataLoader``
+    or anything with ``next()``/``state()``/``load_state()``) or from a pure
+    ``batch_fn(batch_index)``; exactly one must be given.  Batch index i
+    feeds step i until an escalated anomaly rollback skips a bad window
+    (the loop then rides the data cursor forward of the step counter —
+    both are checkpointed, so resume stays sample-exact either way).
+
+    ``rng_seed`` (optional) derives ``step_key = fold_in(PRNGKey(seed),
+    step)`` per step — replay-stable and checkpointed.
+
+    Resumes automatically from ``manager``'s newest committed checkpoint;
+    a checkpoint that commits but fails to restore is quarantined
+    (``step_N.corrupt``) and the next-older one is tried.  A run that
+    never saved CANNOT be restarted in-process after a step exception
+    (the pre-step state is gone once the step ran) — save early.
+
+    NOTE: the anomaly guard reads the loss on the host every step (the
+    same sync ``telemetry.record_step`` opts into); ``VESCALE_BENCH=
+    resilience`` measures the armed-but-quiescent overhead."""
+    if (loader is None) == (batch_fn is None):
+        raise ValueError("exactly one of loader / batch_fn is required")
+    if total_steps <= 0:
+        raise ValueError("total_steps must be positive")
+    import jax
+
+    from .. import telemetry as _tel
+    from ..telemetry import memtrack as _memtrack
+
+    if not _fs.is_armed():
+        _fs.arm_from_env()  # VESCALE_FAULTSIM schedules for scripted runs
+    pol = anomaly or AnomalyPolicy()
+    handler = preemption or PreemptionHandler()
+    own_handler = preemption is None
+    if own_handler and install_signal_handlers:
+        handler.install()
+
+    base_key = jax.random.PRNGKey(rng_seed) if rng_seed is not None else None
+
+    # ---------------------------------------------------------------- state
+    result = RunResult(params=params, opt_state=opt_state, step=-1, status="completed")
+    step = 0  # next step to run
+    data_cursor = 0  # next batch index (>= step after an escalated skip)
+    loss_window: deque = deque(maxlen=max(2, pol.window))
+    bad_streak = 0
+    restart_attempts = 0
+    last_rollback_target: Optional[int] = None
+    escalate_skip = False
+
+    def _extra_state(completed_step: int) -> Dict[str, Any]:
+        # `completed_step` is the step whose output result.params holds;
+        # data_cursor / loader position already point at the NEXT batch
+        return {
+            "step": int(completed_step),
+            "rng_seed": int(rng_seed) if rng_seed is not None else -1,
+            "data_cursor": int(data_cursor),
+            "loader": loader.state() if loader is not None else {},
+        }
+
+    def _ckpt_state(completed_step: int) -> Dict[str, Any]:
+        return {
+            "model": result.params,
+            "optimizer": result.opt_state,
+            "extra": _extra_state(completed_step),
+        }
+
+    def _event(kind: str, **fields) -> None:
+        _tel.record_event(f"resilience_{kind}", **fields)
+
+    def _restore_latest() -> Optional[int]:
+        """Restore the newest committed checkpoint, quarantining any that
+        commit but will not load.  Returns the restored step or None.
+        Mutates result.params/opt_state, step, data_cursor, loader."""
+        nonlocal step, data_cursor
+        while True:
+            target = manager.latest_step()
+            if target is None:
+                return None
+            template = _ckpt_state(0)
+            try:
+                restored = manager.restore(template, step=target)
+            except KeyError as e:
+                # missing array key = STRUCTURAL mismatch (e.g. a manual-loop
+                # checkpoint without the 'extra' tree, or a renamed state
+                # field) — deterministic across every checkpoint, so
+                # quarantining would sideline all the good saves and
+                # silently restart from scratch.  Refuse instead.
+                raise RuntimeError(
+                    f"checkpoint step {target} does not match run_resilient's "
+                    f"state schema ({e}); refusing to quarantine a "
+                    "structurally incompatible (not corrupt) checkpoint — "
+                    "restore it manually or resume with matching state"
+                ) from e
+            except Exception as e:  # corrupt-but-committed: quarantine, go older
+                result.quarantined += 1
+                dst = manager.quarantine(target)
+                if dst is None:
+                    # rename failed (read-only root?): without it the same
+                    # step stays newest-committed and this loop would spin
+                    raise RuntimeError(
+                        f"checkpoint step {target} is unloadable ({e!r}) and "
+                        "could not be quarantined; aborting restore"
+                    ) from e
+                _event("quarantine", ckpt_step=target, path=dst, error=repr(e))
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint step {target} is committed but unloadable "
+                    f"({e!r}); quarantined to {dst} — trying the next-older "
+                    "committed step",
+                    stacklevel=2,
+                )
+                continue
+            result.params = restored["model"]
+            result.opt_state = restored["optimizer"]
+            extra = restored["extra"]
+            result.step = int(extra["step"])
+            step = int(extra["step"]) + 1
+            data_cursor = int(extra["data_cursor"])  # already next-batch index
+            if loader is not None:
+                loader.load_state(jax.tree_util.tree_map(int, extra["loader"]))
+            saved_seed = int(extra["rng_seed"])
+            if rng_seed is not None and saved_seed not in (-1, int(rng_seed)):
+                raise ValueError(
+                    f"checkpoint was written with rng_seed={saved_seed}, this "
+                    f"run uses {rng_seed} — resuming would fork the RNG stream"
+                )
+            return target
+
+    def _next_batch():
+        nonlocal data_cursor
+        batch = loader.next() if loader is not None else batch_fn(data_cursor)
+        data_cursor += 1
+        return batch
+
+    def _save(at_step: int, sync: bool = False) -> None:
+        manager.save(
+            at_step,
+            _ckpt_state(at_step),
+            async_checkpoint=async_save and not sync,
+        )
+
+    # -------------------------------------------------------------- resume
+    resumed = _restore_latest()
+    if resumed is not None:
+        _tel.count("resilience_resumes_total")
+        _event("resume", ckpt_step=resumed)
+
+    try:
+        while True:
+            # ---------------------------------------------- preemption gate
+            _fs.set_step(step)
+            if _fs.fires("preempt", ctx=f"step{step}"):
+                handler.request()
+            if handler.requested():
+                result.status = "preempted"
+                _tel.count("resilience_preemptions_total")
+                # no emergency save mid-anomaly-streak: result.params may be
+                # poisoned, and a preemption must not promote them to the
+                # newest committed checkpoint (resume replays from the last
+                # good one instead — same rule as the periodic save)
+                if result.step >= 0 and bad_streak == 0:
+                    manager.wait_pending()  # drain in-flight async saves
+                    if manager.latest_step() != result.step:
+                        _save(result.step, sync=True)
+                        _tel.count("resilience_emergency_saves_total")
+                        result.emergency_save_step = result.step
+                _event(
+                    "preempted",
+                    at_step=result.step,
+                    signum=handler.signum,
+                    emergency_save=result.emergency_save_step,
+                )
+                return result
+            if step >= total_steps:
+                manager.wait_pending()  # the final async save must commit
+                result.status = "completed"
+                return result
+
+            # ------------------------------------------------- run one step
+            cursor_before = data_cursor
+            try:
+                # batch fetch INSIDE the try: a loader hard failure (retries
+                # exhausted) rides the same restart path as a step exception
+                batch = _next_batch()
+                _fs.check("oom", ctx=f"step{step}")
+                if base_key is not None:
+                    out = step_fn(
+                        result.params,
+                        result.opt_state,
+                        batch,
+                        jax.random.fold_in(base_key, step),
+                    )
+                else:
+                    out = step_fn(result.params, result.opt_state, batch)
+            except KeyboardInterrupt:
+                # a fetched-but-never-trained batch must not stay consumed:
+                # rewind the stream so the emergency save's cursor matches
+                # result.step (otherwise resume silently skips a sample).
+                # Only if the fetch actually advanced the cursor — a Ctrl-C
+                # inside the fetch itself advanced nothing.
+                if data_cursor > cursor_before:
+                    data_cursor = cursor_before
+                    if loader is not None:
+                        st = loader.state()
+                        st["batches_served"] = int(st["batches_served"]) - 1
+                        loader.load_state(st)
+                handler.request()
+                continue
+            except Exception as e:
+                # in-process restart path: flight-record, back off, restore
+                _memtrack.maybe_dump_oom(e)
+                restart_attempts += 1
+                result.restarts += 1
+                _tel.count("resilience_restarts_total")
+                _event("restart", at_step=step, attempt=restart_attempts, error=repr(e))
+                if restart_attempts > max_restarts:
+                    raise
+                if manager.latest_step() is None:
+                    raise  # nothing to restore from: the failure is fatal
+                time.sleep(restart_backoff * (2.0 ** (restart_attempts - 1)))
+                if _restore_latest() is None:
+                    # every committed step was quarantined during restore:
+                    # params/step/cursor were never rewound — retrying would
+                    # train on from post-exception state with no way back
+                    raise RuntimeError(
+                        f"restart after step-{step} failure: no checkpoint "
+                        "survived restore (all quarantined)"
+                    ) from e
+                bad_streak = 0
+                loss_window.clear()
+                continue
+
+            new_params, new_opt_state, loss = out[0], out[1], out[2]
+            loss_val = float(loss)
+            if _fs.fires("nonfinite_loss", ctx=f"step{step}"):
+                loss_val = float("nan")  # observation-level injection: the
+                # compiled step is untouched; the guard sees a NaN burst
+
+            # ------------------------------------------------ anomaly guard
+            anomalous = not math.isfinite(loss_val) or _skip_count(new_opt_state) > 0
+            if (
+                not anomalous
+                and pol.zscore > 0
+                and len(loss_window) >= max(2, pol.min_history)
+            ):
+                mean = sum(loss_window) / len(loss_window)
+                var = sum((v - mean) ** 2 for v in loss_window) / len(loss_window)
+                std = var**0.5
+                if std > 0 and abs(loss_val - mean) > pol.zscore * std:
+                    anomalous = True
+            if anomalous:
+                bad_streak += 1
+                result.anomaly_steps += 1
+                _tel.count("resilience_anomaly_steps_total")
+            else:
+                bad_streak = 0
+                loss_window.append(loss_val)
+
+            if anomalous and bad_streak >= pol.threshold:
+                # ------------------------------------------------- rollback
+                result.rollbacks += 1
+                _tel.count("resilience_rollbacks_total")
+                _memtrack.dump_now(reason=f"anomaly_rollback@step{step}")
+                if result.rollbacks > pol.max_rollbacks:
+                    raise RuntimeError(
+                        f"anomaly guard: {result.rollbacks} rollbacks exceed "
+                        f"max_rollbacks={pol.max_rollbacks}; giving up"
+                    )
+                bad_step = step  # last (anomalous) step that ran
+                if manager.latest_step() is None:
+                    raise RuntimeError(
+                        f"anomaly at step {step} but no committed checkpoint "
+                        "to roll back to (save_every too large?)"
+                    )
+                manager.wait_pending()  # a pending save may hold a bad step
+                target = _restore_latest()
+                if target is None:
+                    # every committed step was quarantined during restore:
+                    # params/step were never rewound — continuing would
+                    # train on from the anomalous state with no way back
+                    raise RuntimeError(
+                        f"anomaly at step {bad_step}: no checkpoint survived "
+                        "restore (all quarantined); cannot roll back"
+                    )
+                escalate_skip = last_rollback_target == target
+                if escalate_skip and loader is not None:
+                    # the SAME window went bad after a clean replay: its
+                    # data is the problem — advance the stream past it
+                    st = loader.state()
+                    st["batches_served"] = bad_step + 1 - step + int(st["batches_served"])
+                    loader.load_state(st)
+                    data_cursor += bad_step + 1 - step
+                elif escalate_skip:
+                    data_cursor += bad_step + 1 - step
+                _tel.count("resilience_rollback_data_skips_total" if escalate_skip else "resilience_rollback_replays_total")
+                _event(
+                    "rollback",
+                    bad_step=bad_step,
+                    restored_step=target,
+                    data_skipped=escalate_skip,
+                )
+                last_rollback_target = target
+                bad_streak = 0
+                loss_window.clear()
+                continue
+
+            # ------------------------------------------------- commit step
+            result.params, result.opt_state = new_params, new_opt_state
+            result.step = step
+            result.losses[step] = loss_val
+            if on_step is not None:
+                on_step(step, loss_val)
+            # periodic save — but NEVER mid-anomaly-streak: a checkpoint of
+            # possibly-poisoned params must not become the rollback target
+            if bad_streak == 0 and (
+                (step + 1) % max(1, save_every) == 0 or step == total_steps - 1
+            ):
+                _save(step)
+                last_rollback_target = None  # clean committed progress:
+                # the next rollback (if any) restores a NEWER step, so
+                # re-arm replay-first semantics
+            step += 1
+    finally:
+        if own_handler and install_signal_handlers:
+            handler.uninstall()

@@ -1,0 +1,205 @@
+"""Retry/backoff policy for flaky I/O — checkpoint storage + data loader.
+
+A production checkpoint write hits object-store throttling, NFS hiccups
+and transient ``OSError``s that a single retry absorbs; without one, a
+40k-step run dies on a 50 ms blip.  ``RetryPolicy`` is the one shared
+mechanism: bounded attempts, exponential backoff with deterministic
+jitter, an optional per-attempt timeout, and telemetry counters
+(``resilience_io_retries_total`` / ``resilience_io_giveups_total``) so
+every absorbed fault is still visible.
+
+Wiring (this PR): ``FileSystemStorage.read_bytes/write_bytes``
+(checkpoint/storage.py) and ``TokenDataLoader.next`` (data/loader.py)
+route through module-default policies built from env knobs:
+
+  ===========================  ======== =====================================
+  env                          default  meaning
+  ---------------------------  -------- -------------------------------------
+  VESCALE_CKPT_RETRIES         3        max attempts for checkpoint I/O
+  VESCALE_LOADER_RETRIES       3        max attempts for loader batch fetch
+  VESCALE_IO_BACKOFF_BASE      0.05     first backoff sleep (seconds)
+  VESCALE_IO_BACKOFF_MAX       5.0      backoff ceiling (seconds)
+  VESCALE_IO_BACKOFF_JITTER    0.25     +/- fraction of jitter on each sleep
+  VESCALE_IO_ATTEMPT_TIMEOUT   0        per-attempt timeout (s); 0 disables
+  ===========================  ======== =====================================
+
+Setting a ``*_RETRIES`` knob to 1 restores fail-fast semantics.  The
+jitter is seeded (attempt index + policy seed), so two runs of the same
+faultsim schedule sleep identically — retries never break determinism of
+anything but wall clock.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple, Type
+
+__all__ = ["RetryPolicy", "ckpt_policy", "loader_policy", "reset_default_policies"]
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded-retry executor.  ``call(fn, *args)`` runs ``fn`` up to
+    ``max_attempts`` times, sleeping ``base * 2**attempt`` (+/- seeded
+    jitter, capped at ``max_backoff``) between attempts; only
+    ``retry_on`` exceptions are retried, everything else propagates
+    immediately.  ``attempt_timeout`` > 0 bounds each attempt by running
+    it on a helper thread (an attempt that never returns leaks that
+    thread until it finishes — the price of killing a hung NFS write)."""
+
+    max_attempts: int = 3
+    base_backoff: float = 0.05
+    max_backoff: float = 5.0
+    jitter: float = 0.25
+    attempt_timeout: float = 0.0
+    seed: int = 0
+    retry_on: Tuple[Type[BaseException], ...] = (OSError, TimeoutError)
+    # deterministic-failure subtypes a retry cannot fix: pass through at once
+    no_retry: Tuple[Type[BaseException], ...] = (
+        FileNotFoundError,
+        IsADirectoryError,
+        NotADirectoryError,
+        PermissionError,
+    )
+    name: str = "io"
+
+    @classmethod
+    def from_env(cls, attempts_var: str, default_attempts: int = 3, name: str = "io"):
+        def _f(var: str, dflt: float) -> float:
+            try:
+                return float(os.environ.get(var, dflt))
+            except ValueError:
+                return dflt
+
+        return cls(
+            max_attempts=max(1, int(_f(attempts_var, default_attempts))),
+            base_backoff=_f("VESCALE_IO_BACKOFF_BASE", 0.05),
+            max_backoff=_f("VESCALE_IO_BACKOFF_MAX", 5.0),
+            jitter=_f("VESCALE_IO_BACKOFF_JITTER", 0.25),
+            attempt_timeout=_f("VESCALE_IO_ATTEMPT_TIMEOUT", 0.0),
+            name=name,
+        )
+
+    # ------------------------------------------------------------- backoff
+    def backoff_for(self, attempt: int) -> float:
+        """Deterministic sleep before retry ``attempt`` (1-based)."""
+        import zlib
+
+        raw = min(self.max_backoff, self.base_backoff * (2.0 ** (attempt - 1)))
+        if self.jitter <= 0:
+            return raw
+        h = zlib.crc32(f"{self.name}:{self.seed}:{attempt}".encode()) / 0xFFFFFFFF
+        return raw * (1.0 + self.jitter * (2.0 * h - 1.0))
+
+    def _run_once(self, fn: Callable, args, kwargs):
+        if self.attempt_timeout <= 0:
+            return fn(*args, **kwargs)
+        # one daemon thread PER timed attempt — never a shared pool: two
+        # hung NFS writes would occupy a pool forever and every later
+        # attempt would "time out" queued without ever executing.  A hung
+        # thread is abandoned (leaks until the syscall returns — the price
+        # of bounding a hung write) and its late result is discarded.
+        box: list = []
+
+        def _runner():
+            try:
+                box.append(("ok", fn(*args, **kwargs)))
+            except BaseException as e:  # delivered to the waiting caller
+                box.append(("err", e))
+
+        t = threading.Thread(
+            target=_runner, name=f"retry-{self.name}-attempt", daemon=True
+        )
+        t.start()
+        t.join(self.attempt_timeout)
+        if not box:
+            raise TimeoutError(
+                f"{self.name}: attempt exceeded {self.attempt_timeout}s"
+            )
+        kind, payload = box[0]
+        if kind == "err":
+            raise payload
+        return payload
+
+    # ---------------------------------------------------------------- call
+    def call(self, fn: Callable, *args, description: str = "", **kwargs):
+        """Run ``fn`` under the policy.  ``description`` names the resource
+        in the absorbed-fault log line — every retried op is visible on
+        stderr even when the run ultimately succeeds."""
+        import sys
+
+        from .. import telemetry as _tel
+
+        last: Optional[BaseException] = None
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return self._run_once(fn, args, kwargs)
+            except self.retry_on as e:
+                if isinstance(e, self.no_retry):
+                    raise  # a retry cannot make a missing file appear
+                last = e
+                if attempt >= self.max_attempts:
+                    break
+                _tel.count("resilience_io_retries_total")
+                _tel.count(f"resilience_{self.name}_retries_total")
+                delay = self.backoff_for(attempt)
+                print(
+                    f"[resilience] {self.name} "
+                    f"{description or getattr(fn, '__name__', 'op')}: attempt "
+                    f"{attempt}/{self.max_attempts} failed ({e!r}); retrying "
+                    f"in {delay:.3f}s",
+                    file=sys.stderr,
+                )
+                if delay > 0:
+                    time.sleep(delay)
+        # retry-exhausted hard failure: count it, then re-raise the ORIGINAL
+        # exception (callers' except clauses keep their established types)
+        _tel.count("resilience_io_giveups_total")
+        assert last is not None
+        raise last
+
+    def wrap(self, fn: Callable, description: str = "") -> Callable:
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, description=description, **kwargs)
+
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapped
+
+
+# ----------------------------------------------------- module default policies
+# Built lazily (first I/O op) so env knobs set by a launcher before the first
+# checkpoint/batch are honored; reset_default_policies() re-reads them (tests).
+_CKPT: Optional[RetryPolicy] = None
+_LOADER: Optional[RetryPolicy] = None
+_LOCK = threading.Lock()
+
+
+def ckpt_policy() -> RetryPolicy:
+    global _CKPT
+    if _CKPT is None:
+        with _LOCK:
+            if _CKPT is None:
+                _CKPT = RetryPolicy.from_env("VESCALE_CKPT_RETRIES", 3, name="ckpt_io")
+    return _CKPT
+
+
+def loader_policy() -> RetryPolicy:
+    global _LOADER
+    if _LOADER is None:
+        with _LOCK:
+            if _LOADER is None:
+                _LOADER = RetryPolicy.from_env("VESCALE_LOADER_RETRIES", 3, name="loader")
+                # native-loader failures surface as RuntimeError, not OSError
+                _LOADER.retry_on = (OSError, RuntimeError, TimeoutError)
+    return _LOADER
+
+
+def reset_default_policies() -> None:
+    """Drop the cached env-derived policies (tests mutate the env)."""
+    global _CKPT, _LOADER
+    with _LOCK:
+        _CKPT = None
+        _LOADER = None

@@ -31,6 +31,7 @@ from .api import (
     is_active,
     observe,
     prometheus_dump,
+    record_event,
     record_step,
     set_gauge,
     shutdown,
@@ -50,6 +51,7 @@ __all__ = [
     "get_state",
     "get_registry",
     "record_step",
+    "record_event",
     "observe",
     "count",
     "set_gauge",

@@ -36,6 +36,7 @@ __all__ = [
     "get_state",
     "get_registry",
     "record_step",
+    "record_event",
     "observe",
     "count",
     "set_gauge",
@@ -169,6 +170,20 @@ def record_step(metrics: Dict[str, Any]) -> None:
         if mem is not None:
             rec["memory"] = mem
         st.jsonl.emit(rec)
+
+
+def record_event(kind: str, **fields) -> None:
+    """Append a non-step EVENT line to steps.jsonl (recovery events:
+    restarts, rollbacks, preemptions, quarantines — the resilience loop's
+    feed).  Events carry ``{"event": kind, "step": <current>, ...fields}``
+    so a dashboard tailing the stream can interleave them with step
+    records.  No-op while dormant or without an out_dir stream."""
+    st = _STATE
+    if st is None or st.jsonl is None:
+        return
+    st.jsonl.emit(
+        {"event": kind, "step": st.step, "rank": st.rank, "ts": time.time(), **fields}
+    )
 
 
 def observe(name: str, value: float) -> None:

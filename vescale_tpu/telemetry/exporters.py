@@ -146,15 +146,32 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     width = 78
     lines = ["=" * width, f"{title:^{width}}", "=" * width]
     mem_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith("mem_")}
-    other_gauges = {n: v for n, v in snap["gauges"].items() if not n.startswith("mem_")}
-    if snap["counters"]:
+    res_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith("resilience_")}
+    other_gauges = {
+        n: v
+        for n, v in snap["gauges"].items()
+        if not n.startswith(("mem_", "resilience_"))
+    }
+    res_counters = {n: v for n, v in snap["counters"].items() if n.startswith("resilience_")}
+    other_counters = {
+        n: v for n, v in snap["counters"].items() if not n.startswith("resilience_")
+    }
+    if other_counters:
         lines.append("counters:")
-        for name in sorted(snap["counters"]):
-            lines.append(f"  {name:<48} {_fmt(snap['counters'][name]):>12}")
+        for name in sorted(other_counters):
+            lines.append(f"  {name:<48} {_fmt(other_counters[name]):>12}")
     if other_gauges:
         lines.append("gauges:")
         for name in sorted(other_gauges):
             lines.append(f"  {name:<48} {other_gauges[name]:>12.6g}")
+    if res_counters or res_gauges:
+        # recovery-event block (resilience/loop.py feed, mirrors memory:):
+        # a zero-fault run shows armed-but-quiet counters at 0
+        lines.append("resilience:")
+        for name in sorted(res_counters):
+            lines.append(f"  {name:<48} {_fmt(res_counters[name]):>12}")
+        for name in sorted(res_gauges):
+            lines.append(f"  {name:<48} {res_gauges[name]:>12.6g}")
     if mem_gauges:
         lines.append("memory:")
         for name in sorted(mem_gauges):
