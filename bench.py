@@ -485,6 +485,129 @@ def bench_resilience():
     }))
 
 
+def bench_watchdog():
+    """Watchdog+consistency overhead rung (VESCALE_BENCH=watchdog): the
+    multi-host resilience layer's armed-but-quiescent per-step price — a
+    live watchdog (heartbeat per step boundary, deadline never reached),
+    coordinated-mode control exchange (trivial on one process, exactly the
+    host path multi-host runs pay minus the wire), and consistency
+    fingerprints at the default cadence (every 32 steps).  Isolated from
+    XLA noise the same way bench_resilience's layer_host_cost is: the
+    delta between two no-op-step run_resilient loops that differ ONLY in
+    watchdog+coordination arming, expressed as a fraction of a real
+    (small-llama) step.  Acceptance: < 1%."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from vescale_tpu.checkpoint import CheckpointManager
+    from vescale_tpu.dmodule import parallelize_module
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.models.llama import Llama, LlamaConfig, llama_plan
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
+    from vescale_tpu.parallel.optimizer import DistributedOptimizer
+    from vescale_tpu.resilience import Watchdog, run_resilient
+    from vescale_tpu.train import make_train_step
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    B, T = (4, 1024) if on_tpu else (2, 64)
+    cfg = LlamaConfig(
+        vocab_size=2048 if on_tpu else 128,
+        hidden_size=256 if on_tpu else 32,
+        intermediate_size=512 if on_tpu else 64,
+        num_hidden_layers=4 if on_tpu else 2,
+        num_attention_heads=4 if on_tpu else 2,
+        num_key_value_heads=4 if on_tpu else 2,
+        max_position_embeddings=T,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    mesh = DeviceMesh(("dp", "tp"), (1, 1), devices=devices[:1])
+    dm = parallelize_module(Llama(cfg), mesh, llama_plan(mesh, sequence_parallel=False))
+    params = dm.init(jax.random.key(0), jnp.ones((2, T), jnp.int32))["params"]
+    dopt = DistributedOptimizer(optax.adamw(1e-3))
+    opt_state = dopt.init(params)
+    step = make_train_step(
+        dm, dopt, lambda lg, b: cross_entropy_loss(lg, b["target"]), donate=False
+    )
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T + 1)), jnp.int32)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+    iters = 30 if on_tpu else 100
+
+    p, s = params, opt_state
+    for _ in range(3):  # compile outside every timed window
+        p, s, loss = step(p, s, batch)
+    float(loss)
+
+    def _median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    def real_step_time():
+        p, s = params, opt_state
+        ts = [time.perf_counter()]
+        for _ in range(iters):
+            p, s, loss = step(p, s, batch)
+            float(loss)
+            ts.append(time.perf_counter())
+        return _median([b - a for a, b in zip(ts, ts[1:])])
+
+    nop_out = ({"w": np.float32(0)}, {"m": np.float32(0)}, 1.0)
+
+    def _nop_loop(nul_iters, **kw):
+        root = tempfile.mkdtemp(prefix="bench_watchdog_")
+        ts = []
+        run_resilient(
+            step_fn=lambda p, o, b, k=None: nop_out,
+            params=nop_out[0],
+            opt_state=nop_out[1],
+            manager=CheckpointManager(root, keep=1),
+            batch_fn=lambda i: batch,
+            total_steps=nul_iters + 1,
+            save_every=10**9,  # the forced final save stays untimed
+            async_save=False,
+            install_signal_handlers=False,
+            on_step=lambda i, l: ts.append(time.perf_counter()),
+            **kw,
+        )
+        deltas = sorted(b - a for a, b in zip(ts, ts[1:]))[: nul_iters - 1]
+        return sum(deltas) / len(deltas)
+
+    nul_iters = 2000
+    wd = Watchdog(timeout_s=3600.0, abort=False)  # armed, never due
+    wd.start()
+    try:
+        armed = _nop_loop(nul_iters, watchdog=wd)
+        coord = _nop_loop(nul_iters, watchdog=wd, coordinate=True, consistency_every=32)
+        plain = _nop_loop(nul_iters)
+        armed = min(armed, _nop_loop(nul_iters, watchdog=wd))
+        coord = min(coord, _nop_loop(
+            nul_iters, watchdog=wd, coordinate=True, consistency_every=32
+        ))
+        plain = min(plain, _nop_loop(nul_iters))
+    finally:
+        wd.stop()
+    wd_layer = max(0.0, armed - plain)  # the watchdog heartbeat alone
+    coord_layer = max(0.0, coord - plain)  # + control exchange + fingerprints
+    base = real_step_time()
+    assert wd.fired == 0, "watchdog fired during a quiescent bench"
+    print(json.dumps({
+        "metric": "watchdog_overhead_frac" if on_tpu else "watchdog_overhead_frac_cpu",
+        "value": round(wd_layer / base, 6) if base > 0 else None,
+        "unit": "fraction",
+        "watchdog_us_per_step": round(wd_layer * 1e6, 2),
+        "coord_us_per_step": round(coord_layer * 1e6, 2),
+        "coord_overhead_frac": round(coord_layer / base, 5) if base > 0 else None,
+        "step_ms_real": round(base * 1e3, 3),
+        "nop_us_plain": round(plain * 1e6, 2),
+        "iters": nul_iters,
+        "acceptance_lt": 0.01,
+    }))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -601,6 +724,8 @@ def _dispatch():
         bench_memtrack()
     elif which == "resilience":
         bench_resilience()
+    elif which == "watchdog":
+        bench_watchdog()
     elif which == "redistribute":
         # multi-hop planner battery (VESCALE_BENCH=redistribute): plan
         # length, bytes moved and retrace count per representative

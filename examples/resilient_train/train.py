@@ -94,9 +94,21 @@ def main():
         params, opt_state = dopt.step(params, opt_state, grads)
         return params, opt_state, unscaled
 
+    # optional hang watchdog (VESCALE_WATCHDOG_TIMEOUT=30 arms it): a step
+    # that stops making progress dumps all-thread stacks and aborts so a
+    # supervisor restart resumes from the last committed step — see
+    # docs/resilience.md "Multi-host: coordinated recovery"
+    from vescale_tpu.resilience import Watchdog
+
+    wd = Watchdog.from_env()
+    if wd is not None:
+        wd.start()
+
     rng = np.random.default_rng(0)
     handle = None
     for i in range(start, args.steps):
+        if wd is not None:
+            wd.beat(i)
         toks = jnp.asarray(
             rng.integers(0, cfg.vocab_size, (args.dp * 4, cfg.block_size + 1)), jnp.int32
         )
@@ -112,6 +124,8 @@ def main():
             os._exit(137)
     if handle is not None:
         handle.wait()  # only the LAST save is worth blocking the exit for
+    if wd is not None:
+        wd.stop()
     print(f"done; latest committed checkpoint: step {mgr.latest_step()}")
 
 

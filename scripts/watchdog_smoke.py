@@ -1,0 +1,174 @@
+"""Watchdog smoke: injected hang -> stack dump -> clean abort -> the
+auto-restarted run resumes from the committed checkpoint and finishes.
+
+The supervisor half of the resilience story that resilience_smoke.py's
+in-process recovery cannot cover: a HANG has no exception to catch — the
+only way out is a process-level abort, so the proof needs two processes
+of the same training script (exactly how a production supervisor sees it):
+
+  run 1  VESCALE_FAULTSIM="hang:step=5" wedges the loop mid-run; the
+         watchdog (VESCALE_WATCHDOG_TIMEOUT=2) must detect the stall
+         within its deadline, write the all-thread stack dump, and abort
+         with the watchdog exit code (17) — NOT hang until the scheduler
+         kills the allocation.
+  run 2  same command, no fault: auto-resume from the newest committed
+         step (the step-2 save), completing the run.  Final losses must
+         be BIT-IDENTICAL to an uninterrupted golden run — the hang cost
+         one checkpoint interval, not correctness.
+
+Exercised end to end: faultsim hang kind, Watchdog.from_env arming inside
+run_resilient, step-boundary beats, dump bundle schema, abort exit code,
+auto-resume.  Wired into tier-1 via tests/test_multihost_resilience.py.
+"""
+
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+TOTAL = 9
+SAVE_EVERY = 3  # saves commit at steps 2, 5, 8
+HANG_STEP = 5
+WD_TIMEOUT = 2.0
+WD_EXIT = 17
+
+
+def child(root: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from vescale_tpu.checkpoint import CheckpointManager
+    from vescale_tpu.resilience import run_resilient
+
+    def batch_fn(i):
+        g = np.random.default_rng(40 + i)
+        return g.normal(size=(8,)).astype(np.float32)
+
+    def step_fn(params, opt, batch, key=None):
+        time.sleep(0.02)  # a "step" long enough that beats matter
+        w = params["w"] - 0.1 * (params["w"] - batch.astype(np.float64))
+        return {"w": w}, {"n": opt["n"] + 1}, float((w**2).mean())
+
+    mgr = CheckpointManager(root, keep=3)
+    res = run_resilient(
+        step_fn=step_fn,
+        params={"w": np.zeros(8, np.float64)},
+        opt_state={"n": 0},
+        manager=mgr,
+        batch_fn=batch_fn,
+        total_steps=TOTAL,
+        save_every=SAVE_EVERY,
+        async_save=False,  # commits land before the next step runs — the
+        # step-2 checkpoint must deterministically exist when the injected
+        # hang aborts the process (the smoke tests the watchdog, not
+        # fire-and-forget commit timing under CI load)
+        rng_seed=3,
+        install_signal_handlers=False,
+        # watchdog arms itself from VESCALE_WATCHDOG_TIMEOUT/_ABORT/_DIR
+    )
+    assert res.status == "completed", res.status
+    for s in sorted(res.losses):
+        print(f"loss step={s} {res.losses[s]:.17g}")
+    print(f"done step={res.step}")
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_child(root: str, env_extra: dict) -> subprocess.CompletedProcess:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env["PYTHONPATH"] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+    for k in (
+        "VESCALE_FAULTSIM",
+        "VESCALE_FAULTSIM_HANG_S",
+        "VESCALE_WATCHDOG_TIMEOUT",
+        "VESCALE_WATCHDOG_ABORT",
+        "VESCALE_WATCHDOG_DIR",
+    ):
+        env.pop(k, None)
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", root],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env=env,
+    )
+
+
+def main() -> None:
+    work = tempfile.mkdtemp(prefix="watchdog_smoke_")
+    try:
+        root = os.path.join(work, "ckpt")
+        golden_root = os.path.join(work, "golden")
+        dump_dir = os.path.join(work, "wd")
+
+        # ---- golden: uninterrupted run, the bit-exactness reference
+        golden = run_child(golden_root, {})
+        assert golden.returncode == 0, golden.stdout + golden.stderr
+        golden_losses = [l for l in golden.stdout.splitlines() if l.startswith("loss ")]
+        assert len(golden_losses) == TOTAL
+
+        # ---- run 1: injected hang -> watchdog must abort within deadline
+        t0 = time.monotonic()
+        hung = run_child(
+            root,
+            {
+                "VESCALE_FAULTSIM": f"hang:step={HANG_STEP}",
+                "VESCALE_FAULTSIM_HANG_S": "300",
+                "VESCALE_WATCHDOG_TIMEOUT": str(WD_TIMEOUT),
+                "VESCALE_WATCHDOG_ABORT": "1",
+                "VESCALE_WATCHDOG_DIR": dump_dir,
+            },
+        )
+        elapsed = time.monotonic() - t0
+        assert hung.returncode == WD_EXIT, (
+            f"expected watchdog abort rc={WD_EXIT}, got {hung.returncode}\n"
+            + hung.stdout
+            + hung.stderr
+        )
+        # detection well inside the 300s injected stall: deadline + step
+        # time + interpreter startup, nothing else
+        assert elapsed < 120, f"detection took {elapsed:.0f}s"
+        assert "[watchdog] no step progress" in hung.stderr, hung.stderr[-2000:]
+        dumps = glob.glob(os.path.join(dump_dir, "watchdog_hang_*.json"))
+        assert dumps, os.listdir(dump_dir) if os.path.isdir(dump_dir) else "no dump dir"
+        bundle = json.load(open(dumps[0]))
+        assert bundle["reason"] == "hang" and bundle["step"] == HANG_STEP
+        assert any("MainThread" in k for k in bundle["threads"]), bundle["threads"].keys()
+        # the hang hit AFTER the step-2 save committed
+        assert os.path.exists(os.path.join(root, "step_0000000002", "meta.json"))
+
+        # ---- run 2: the supervisor's restart — resumes and completes
+        resumed = run_child(root, {})
+        assert resumed.returncode == 0, resumed.stdout + resumed.stderr
+        resumed_losses = [l for l in resumed.stdout.splitlines() if l.startswith("loss ")]
+        # resumed from step 2's commit: losses start at step 3
+        assert resumed_losses[0].startswith("loss step=3 "), resumed_losses[:1]
+        # bit-identical tail vs the uninterrupted golden run
+        assert resumed_losses == golden_losses[3:], (
+            "resumed run diverged:\n"
+            + "\n".join(resumed_losses)
+            + "\n-- golden --\n"
+            + "\n".join(golden_losses[3:])
+        )
+        print(
+            f"WATCHDOG SMOKE OK: hang detected in {elapsed:.1f}s, "
+            f"{len(dumps)} stack dump(s), restart resumed at step 3 and "
+            f"matched golden bit-exactly"
+        )
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[2])
+    else:
+        main()

@@ -1,0 +1,217 @@
+"""Multi-host resilience worker for the 2-process x 4-device CPU rig.
+
+Each mode exercises one leg of the coordinated-recovery protocol
+(resilience/loop.py multi-host section):
+
+  commit_fault    rank 1's shard writes fail on every save -> the all-rank
+                  commit vote fails, meta.json is never written, rotation
+                  never prunes, the run STILL completes — no checkpoint
+                  counts committed anywhere (the torn-commit regression).
+  desync_rng      rank 1 runs with a skewed RNG seed -> the consistency
+                  fingerprint mismatches on the first check, DesyncError
+                  raises on BOTH ranks before any save commits.
+  preempt_agree   faultsim preempts rank 0 only -> the control exchange
+                  agrees, both ranks drain, emergency-save (two-phase) and
+                  exit "preempted" with the SAME emergency step.
+  barrier_timeout rank 1 never enters the barrier -> rank 0 gets a
+                  BarrierTimeout naming the tag instead of hanging.
+  hang            rank 1 stalls at a step boundary (faultsim hang kind);
+                  its watchdog dumps stacks and aborts with the watchdog
+                  exit code; rank 0's bounded collectives/watchdog abort
+                  too.  The driver then re-runs WITHOUT the fault
+                  (mode=train) and the restarted run resumes from the last
+                  committed step and completes.
+  train           plain coordinated run to completion (the restart leg of
+                  the hang scenario; also asserts commit-at-next-boundary
+                  checkpoints restore).
+
+The training state is deliberately mixed: a tp-sharded weight (both
+processes own shard chunks -> both vote with real writes at stake), a
+replicated bias (exercises the replicated-sample fingerprint), and np
+scalars in the optimizer state.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import vescale_tpu.distributed as vdist  # noqa: E402
+
+vdist.initialize()
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from vescale_tpu.checkpoint import CheckpointManager  # noqa: E402
+from vescale_tpu.resilience import (  # noqa: E402
+    DesyncError,
+    run_resilient,
+)
+
+root = sys.argv[1]
+mode = sys.argv[2]
+me = vdist.process_index()
+assert vdist.process_count() == 2
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+mesh = vdist.hybrid_device_mesh(("dp", "tp"), ici_shape=(4,), dcn_shape=(2,))
+
+w_sh = NamedSharding(mesh.jax_mesh, P(None, "tp"))
+r_sh = NamedSharding(mesh.jax_mesh, P())
+x_sh = NamedSharding(mesh.jax_mesh, P("dp", None))
+
+rng = np.random.default_rng(0)
+wnp = rng.normal(size=(16, 32)).astype(np.float32) * 0.1
+bnp = np.zeros((32,), np.float32)
+mk = jax.make_array_from_callback
+params0 = {
+    "W": mk(wnp.shape, w_sh, lambda i: wnp[i]),
+    "b": mk(bnp.shape, r_sh, lambda i: bnp[i]),
+}
+opt0 = {"count": np.int64(0)}
+
+BATCHES = 64
+
+
+def batch_fn(i):
+    """Deterministic global batch i — identical construction on each rank;
+    x is dp-sharded across the two processes."""
+    g = np.random.default_rng(1000 + (i % BATCHES))
+    xnp = g.normal(size=(8, 16)).astype(np.float32)
+    ynp = g.normal(size=(8, 32)).astype(np.float32)
+    return {
+        "x": mk(xnp.shape, x_sh, lambda idx: xnp[idx]),
+        "y": mk(ynp.shape, x_sh, lambda idx: ynp[idx]),
+    }
+
+
+@jax.jit
+def _step(params, count, batch):
+    def loss_fn(p):
+        pred = batch["x"] @ p["W"] + p["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    new = jax.tree_util.tree_map(lambda p, g: p - 0.05 * g, params, grads)
+    return new, count + 1, loss
+
+
+def step_fn(params, opt_state, batch, step_key=None):
+    new_params, count, loss = _step(params, jnp.asarray(opt_state["count"]), batch)
+    return new_params, {"count": np.int64(int(count))}, loss
+
+
+TOTAL = 8
+SAVE_EVERY = 3  # saves at steps 2, 5, 7
+
+seed = 7 + (1 if (mode == "desync_rng" and me == 1) else 0)
+mgr = CheckpointManager(root, keep=3)
+
+
+def _run(**kw):
+    args = dict(
+        step_fn=step_fn,
+        params=params0,
+        opt_state=opt0,
+        manager=mgr,
+        batch_fn=batch_fn,
+        total_steps=TOTAL,
+        save_every=SAVE_EVERY,
+        async_save=True,
+        rng_seed=seed,
+        install_signal_handlers=False,
+        barrier_timeout_s=60.0,
+    )
+    args.update(kw)
+    return run_resilient(**args)
+
+
+if mode == "commit_fault":
+    # VESCALE_FAULTSIM="storage_write:call=0,count=100000,rank=1" and
+    # VESCALE_CKPT_RETRIES=1 come from the driver: every rank-1 shard write
+    # fails, so every commit vote must fail on BOTH ranks
+    res = _run()
+    assert res.status == "completed" and res.step == TOTAL - 1, (res.status, res.step)
+    assert mgr.latest_step() is None, f"step {mgr.latest_step()} committed on rank {me}"
+    assert mgr.latest_common_step() is None
+    # no meta.json anywhere: the torn-commit regression — a failed vote
+    # must leave nothing that counts committed on ANY rank
+    for d in sorted(os.listdir(root)):
+        assert not os.path.exists(os.path.join(root, d, "meta.json")), d
+    print(f"final_loss={res.losses[TOTAL - 1]:.6f}")
+
+elif mode == "desync_rng":
+    try:
+        res = _run(consistency_every=2, save_every=100)
+    except DesyncError as e:
+        assert "rng_seed" in e.mismatched, e.mismatched
+        # flagged BEFORE any save could commit divergent state
+        assert mgr.latest_step() is None
+        print("desync_detected")
+    else:
+        raise AssertionError(f"desync not detected (rank {me}): {res}")
+
+elif mode == "preempt_agree":
+    # driver arms VESCALE_FAULTSIM="preempt:step=4,rank=0": only rank 0's
+    # flag is ever set locally; rank 1 must learn it from the exchange
+    res = _run(save_every=100)
+    assert res.status == "preempted", res.status
+    assert res.step == 3 and res.emergency_save_step == 3, (
+        res.step,
+        res.emergency_save_step,
+    )
+    assert mgr.latest_step() == 3 and mgr.latest_common_step() == 3
+    print("preempted_at=3")
+
+elif mode == "barrier_timeout":
+    import time
+
+    from vescale_tpu.distributed import BarrierTimeout, barrier
+
+    if me == 0:
+        try:
+            barrier("bt_probe", timeout_s=2.0)
+        except BarrierTimeout as e:
+            assert e.tag == "bt_probe" and e.elapsed_s >= 2.0, (e.tag, e.elapsed_s)
+            print(f"barrier_timeout_raised\nOK proc {me}", flush=True)
+            # the BarrierTimeout contract: the collective is still pending
+            # on the leaked helper thread, so the process must exit WITHOUT
+            # further collectives — including jax's distributed shutdown
+            # (which would trade a diagnosed timeout for an abort)
+            os._exit(0)
+        raise AssertionError("barrier did not time out")
+    else:
+        # the hung-peer stand-in: alive and heartbeating (a DEAD peer would
+        # trip jax's coordination-service panic instead — a hang is the
+        # harder, silent case) but never entering the barrier.  Rank 0's
+        # exit tears the coordination service down under us, so our own
+        # exit status is undefined — the driver only asserts on rank 0.
+        time.sleep(60.0)
+        print(f"OK proc {me}", flush=True)
+        os._exit(0)
+
+elif mode == "hang":
+    # driver arms VESCALE_FAULTSIM="hang:step=5,rank=1" + watchdog env:
+    # rank 1 stalls after the step-2 save committed; both watchdogs abort.
+    # Unreachable-on-success: the watchdog must kill us first.
+    res = _run(save_every=3, watchdog_timeout_s=4.0)
+    raise AssertionError(f"run survived an injected hang (rank {me}): {res}")
+
+elif mode == "train":
+    res = _run()
+    assert res.status == "completed" and res.step == TOTAL - 1
+    # the restart leg of the hang scenario resumes from the committed save
+    if os.environ.get("EXPECT_RESUME") == "1":
+        assert res.restarts == 0
+        assert min(res.losses) > 0, "expected resume: losses must start past step 0"
+    print(f"final_loss={res.losses[TOTAL - 1]:.6f}")
+
+else:
+    raise SystemExit(f"unknown mode {mode!r}")
+
+print(f"OK proc {me}")

@@ -20,7 +20,18 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_two_process_worker(worker_name: str, tmp_path):
+def _spawn_two_process_worker(
+    worker_name: str,
+    tmp_path,
+    args=(),
+    extra_env=None,
+    per_rank_env=None,
+    timeout=420,
+):
+    """Spawn the 2-process x 4-device CPU rig and collect (returncode, out)
+    per rank.  ``extra_env`` applies to both ranks; ``per_rank_env`` is a
+    {rank: {var: val}} overlay (the multi-host resilience tests inject
+    faults / skew state on exactly one rank this way)."""
     repo = pathlib.Path(__file__).resolve().parent.parent
     worker = repo / "tests" / "multiproc" / worker_name
     port = _free_port()
@@ -34,6 +45,10 @@ def _run_two_process_worker(worker_name: str, tmp_path):
             JAX_PLATFORMS="cpu",
             PYTHONPATH=f"{repo}:{env.get('PYTHONPATH', '')}",
         )
+        if extra_env:
+            env.update({k: str(v) for k, v in extra_env.items()})
+        if per_rank_env and pid in per_rank_env:
+            env.update({k: str(v) for k, v in per_rank_env[pid].items()})
         flags = [
             f
             for f in env.get("XLA_FLAGS", "").split()
@@ -42,7 +57,7 @@ def _run_two_process_worker(worker_name: str, tmp_path):
         env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=4"])
         procs.append(
             subprocess.Popen(
-                [sys.executable, str(worker), str(tmp_path / "ckpt")],
+                [sys.executable, str(worker), str(tmp_path / "ckpt"), *map(str, args)],
                 env=env,
                 cwd=str(repo),
                 stdout=subprocess.PIPE,
@@ -53,14 +68,19 @@ def _run_two_process_worker(worker_name: str, tmp_path):
     outs = []
     for pid, p in enumerate(procs):
         try:
-            out, _ = p.communicate(timeout=420)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
         outs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc {pid} failed:\n{out[-4000:]}"
+    return [(p.returncode, out) for p, out in zip(procs, outs)]
+
+
+def _run_two_process_worker(worker_name: str, tmp_path, args=(), extra_env=None):
+    results = _spawn_two_process_worker(worker_name, tmp_path, args=args, extra_env=extra_env)
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"proc {pid} failed:\n{out[-4000:]}"
         assert f"OK proc {pid}" in out
 
 

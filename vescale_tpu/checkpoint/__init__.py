@@ -304,8 +304,30 @@ def _save_impl(
                 )
         elif not ok:
             raise RuntimeError(f"checkpoint save {path}: write failure; not committing")
+        meta_err: Optional[BaseException] = None
         if me == 0:
-            storage.write_bytes("meta.json", json.dumps(meta).encode())
+            if nproc > 1:
+                try:
+                    storage.write_bytes("meta.json", json.dumps(meta).encode())
+                except BaseException as e:
+                    meta_err = e  # voted below — a bare raise here would
+                    # leave the other ranks wedged in the post-commit sync
+            else:
+                storage.write_bytes("meta.json", json.dumps(meta).encode())
+        if nproc > 1:
+            # post-commit sync, as a VOTE on the meta write: by the time
+            # wait()/save() returns on ANY process the marker is durable —
+            # a rank listing the root right after its own commit returned
+            # must not miss the step it just committed — and a process-0
+            # write failure surfaces as an error on EVERY rank instead of
+            # hanging the peers at a barrier rank 0 never reaches
+            from ..distributed import all_processes_ok
+
+            if not all_processes_ok(meta_err is None, f"ckpt_commit_done:{path}"):
+                raise RuntimeError(
+                    f"checkpoint save {path}: meta.json commit-marker write "
+                    "failed on process 0; step is not committed"
+                ) from meta_err
         if on_commit is not None:
             on_commit()
 

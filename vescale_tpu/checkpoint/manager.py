@@ -148,6 +148,30 @@ class CheckpointManager:
         steps = self._committed_steps()
         return steps[-1] if steps else None
 
+    def latest_common_step(self, timeout_s: Optional[float] = None) -> Optional[int]:
+        """Newest step committed AS SEEN BY EVERY process — the only safe
+        restore target in a multi-host run.  Each process's local directory
+        listing can disagree (a shared filesystem propagating a commit, a
+        straggler that missed a prune), and ranks restoring DIFFERENT steps
+        is a guaranteed desync; the intersection-of-committed-sets makes the
+        choice identical everywhere by construction.  Single-process:
+        ``latest_step``.  ``timeout_s`` as in ``distributed.barrier``."""
+        if jax.process_count() == 1:
+            return self.latest_step()
+        from ..distributed import allgather_ints
+
+        # fixed-width exchange: newest K steps padded with -1 (allgather
+        # needs same-shape rows); K=16 >> keep, so the intersection can
+        # only miss steps rotation already pruned somewhere
+        K = 16
+        mine = self._committed_steps()[-K:]
+        row = [-1] * (K - len(mine)) + mine
+        rows = allgather_ints(row, tag="ckpt_latest_common", timeout_s=timeout_s)
+        common = {int(v) for v in rows[0] if v >= 0}
+        for r in rows[1:]:
+            common &= {int(v) for v in r if v >= 0}
+        return max(common) if common else None
+
     def quarantine(self, step: int) -> Optional[str]:
         """Sideline a committed-but-unloadable step: rename its dir to
         ``step_<N>.corrupt`` so ``latest_step`` skips it (the restore-time
@@ -173,10 +197,14 @@ class CheckpointManager:
         if jax.process_count() > 1:
             # every process calls quarantine on the shared restore failure;
             # nobody may re-list the root (and retry the same step, issuing
-            # mismatched collective loads) until process 0's rename landed
-            from ..distributed import barrier
+            # mismatched collective loads) until process 0's rename landed.
+            # The sync doubles as a VOTE on the rename so a failure on
+            # process 0 aborts every rank together (asymmetric knowledge of
+            # a failed quarantine would leave rank 0 raising while the
+            # others retry the same step — a guaranteed desync)
+            from ..distributed import all_processes_ok
 
-            barrier(f"ckpt_quarantine:{step}")
+            renamed = all_processes_ok(renamed, f"ckpt_quarantine:{step}")
         if not renamed:
             return None
         from .. import telemetry as _tel
@@ -193,13 +221,24 @@ class CheckpointManager:
         for s in sorted(pending):
             h = pending[s]
             if h.failed:
+                self._commit_failed(s, h.error)
                 h.drain()
                 continue
             try:
                 h.wait()
-            except Exception:
-                pass  # the failed step never commits; the emergency save matters
+            except Exception as e:
+                # the failed step never commits anywhere (the commit vote
+                # already erred on every process); surface it and move on —
+                # the emergency save / next periodic save is what matters
+                self._commit_failed(s, e)
             h.drain()
+
+    @staticmethod
+    def _commit_failed(step: int, error) -> None:
+        from .. import telemetry as _tel
+
+        _tel.count("resilience_commit_failures_total")
+        _tel.record_event("resilience_commit_failed", ckpt_step=step, error=repr(error))
 
     # -------------------------------------------------------------- save
     def save(

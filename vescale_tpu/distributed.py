@@ -16,7 +16,9 @@ VESCALE_NUM_PROCESSES).
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence, Tuple
+import threading
+import time
+from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -30,10 +32,108 @@ __all__ = [
     "process_count",
     "barrier",
     "all_processes_ok",
+    "allgather_ints",
+    "BarrierTimeout",
     "hybrid_device_mesh",
 ]
 
 _INITIALIZED = False
+
+
+class BarrierTimeout(RuntimeError):
+    """A cross-process sync point did not complete within its deadline —
+    the diagnosable surface of a dead/hung peer (without a timeout the
+    healthy processes block in the collective forever).
+
+    After this raises, the underlying collective is STILL pending on a
+    leaked helper thread: the process must not issue further collectives.
+    The intended reaction is the watchdog's: dump diagnostics and abort so
+    the external restart path takes over (resilience/watchdog.py)."""
+
+    def __init__(self, tag: str, elapsed_s: float, timeout_s: float):
+        self.tag = tag
+        self.elapsed_s = float(elapsed_s)
+        self.timeout_s = float(timeout_s)
+        super().__init__(
+            f"barrier {tag!r} timed out after {elapsed_s:.1f}s "
+            f"(timeout {timeout_s:g}s) — a peer process is hung or dead"
+        )
+
+
+def _resolve_timeout(timeout_s: Optional[float]) -> Optional[float]:
+    """None -> VESCALE_BARRIER_TIMEOUT (unset = no timeout); <= 0 disables."""
+    if timeout_s is None:
+        env = os.environ.get("VESCALE_BARRIER_TIMEOUT")
+        if not env:
+            return None
+        timeout_s = float(env)
+    return timeout_s if timeout_s > 0 else None
+
+
+class _SyncWorker:
+    """One reusable daemon thread that runs timed collectives — a fresh
+    ``threading.Thread`` per call would put thread-spawn cost (~50-100us)
+    on the per-step coordination path whenever ``VESCALE_BARRIER_TIMEOUT``
+    is armed.  Daemon on purpose: a worker wedged in a timed-out
+    collective must not block interpreter exit (which is why this is not a
+    ``ThreadPoolExecutor`` — its workers are non-daemon and joined at
+    exit).  After a timeout the worker is abandoned (``busy`` stays set)
+    and the next call spawns a replacement — threads leak only per
+    timeout, never per call, and the post-timeout contract is abort
+    anyway."""
+
+    def __init__(self):
+        import queue
+
+        self._q: "queue.Queue" = queue.Queue()
+        self.busy = False
+        threading.Thread(target=self._run, name="vescale-sync", daemon=True).start()
+
+    def _run(self) -> None:
+        while True:
+            fn, box, done = self._q.get()
+            try:
+                box["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+                box["error"] = e
+            finally:
+                self.busy = False
+                done.set()
+
+    def submit(self, fn: Callable):
+        box: dict = {}
+        done = threading.Event()
+        self.busy = True
+        self._q.put((fn, box, done))
+        return box, done
+
+
+_SYNC_WORKER: Optional[_SyncWorker] = None
+
+
+def _sync_with_timeout(fn: Callable, tag: str, timeout_s: Optional[float]):
+    """Run a blocking collective with an optional deadline.  With a timeout
+    the collective runs on the shared daemon worker; on expiry the caller
+    gets ``BarrierTimeout`` while the worker stays blocked in the
+    collective — acceptable only because the contract is
+    abort-after-timeout (see ``BarrierTimeout``)."""
+    global _SYNC_WORKER
+    timeout_s = _resolve_timeout(timeout_s)
+    if timeout_s is None:
+        return fn()
+    if _SYNC_WORKER is None or _SYNC_WORKER.busy:
+        _SYNC_WORKER = _SyncWorker()  # first use, or the previous worker
+        # is still wedged in a timed-out collective
+    t0 = time.monotonic()
+    box, done = _SYNC_WORKER.submit(fn)
+    if not done.wait(timeout_s):
+        from . import telemetry as _tel
+
+        _tel.count("resilience_barrier_timeouts_total")
+        raise BarrierTimeout(tag, time.monotonic() - t0, timeout_s)
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
 
 
 def initialize(
@@ -56,6 +156,21 @@ def initialize(
         num_processes = int(os.environ["VESCALE_NUM_PROCESSES"])
     if process_id is None and "VESCALE_PROCESS_ID" in os.environ:
         process_id = int(os.environ["VESCALE_PROCESS_ID"])
+    if num_processes is not None and num_processes > 1:
+        # CPU multi-process (the spawned-worker test rig): the default CPU
+        # client has NO cross-process collectives ("Multiprocess
+        # computations aren't implemented on the CPU backend"); jaxlib
+        # ships a gloo implementation — select it before the backend
+        # initializes.  TPU pods auto-detect (num_processes None) and
+        # never take this branch; jax builds without the flag just skip.
+        plats = os.environ.get("JAX_PLATFORMS", "") or str(
+            getattr(jax.config, "jax_platforms", None) or ""
+        )
+        if "cpu" in plats:
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -77,32 +192,69 @@ def process_count() -> int:
     return jax.process_count()
 
 
-def barrier(tag: str = "vescale_barrier") -> None:
+def barrier(tag: str = "vescale_barrier", timeout_s: Optional[float] = None) -> None:
     """Block until every process reaches this point (reference
-    dist.barrier).  Implemented as a tiny global-device psum."""
+    dist.barrier).  Implemented as a tiny global-device psum.
+
+    ``timeout_s`` (default: ``VESCALE_BARRIER_TIMEOUT`` env, unset = wait
+    forever; <= 0 disables) raises ``BarrierTimeout`` naming the tag and
+    the elapsed time instead of hanging on a dead peer."""
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(tag)
+    _sync_with_timeout(lambda: multihost_utils.sync_global_devices(tag), tag, timeout_s)
 
 
-def all_processes_ok(ok: bool, tag: str = "vescale_ok") -> bool:
+def all_processes_ok(
+    ok: bool, tag: str = "vescale_ok", timeout_s: Optional[float] = None
+) -> bool:
     """Cross-process AND of a local success flag; doubles as a barrier.
 
     The agreement step a commit protocol needs so one process's failure
     surfaces as an error EVERYWHERE instead of a barrier mismatch that
-    hangs the healthy processes forever."""
+    hangs the healthy processes forever.  ``timeout_s`` as in ``barrier``:
+    a peer that never votes raises ``BarrierTimeout`` instead of blocking."""
     if jax.process_count() == 1:
         return bool(ok)
     from jax.experimental import multihost_utils
 
-    # tagged sync first: two processes voting at DIFFERENTLY-tagged points
-    # (e.g. commits of two different checkpoints) must fail fast, not pair
-    # their votes up silently — process_allgather itself carries no tag
-    multihost_utils.sync_global_devices(tag)
-    flags = multihost_utils.process_allgather(np.asarray([1 if ok else 0], np.int32))
-    return bool(np.all(flags))
+    def _vote() -> bool:
+        # tagged sync first: two processes voting at DIFFERENTLY-tagged
+        # points (e.g. commits of two different checkpoints) must fail fast,
+        # not pair their votes up silently — process_allgather itself
+        # carries no tag
+        multihost_utils.sync_global_devices(tag)
+        flags = multihost_utils.process_allgather(np.asarray([1 if ok else 0], np.int32))
+        return bool(np.all(flags))
+
+    return _sync_with_timeout(_vote, tag, timeout_s)
+
+
+def allgather_ints(
+    values: Sequence[int],
+    tag: str = "vescale_allgather",
+    timeout_s: Optional[float] = None,
+) -> np.ndarray:
+    """All-gather a small int64 vector from every process; returns an array
+    of shape ``(process_count, len(values))`` with row p from process p.
+    The control-plane primitive of the resilience layer: the per-step
+    coordination vector, consistency fingerprints and committed-step
+    agreement all ride on it.  Single-process: the input as one row."""
+    row = np.asarray(list(values), np.int64).reshape(-1)
+    if jax.process_count() == 1:
+        return row.reshape(1, -1)
+    from jax.experimental import multihost_utils
+
+    def _gather() -> np.ndarray:
+        # untagged by design (unlike all_processes_ok): callers exchange at
+        # a CONSTANT tag so mismatched positions surface as a comparable
+        # vector difference (consistency.DesyncError names the fields)
+        # rather than a raw tag-hash assertion
+        return np.asarray(multihost_utils.process_allgather(row))
+
+    out = _sync_with_timeout(_gather, tag, timeout_s)
+    return out.reshape(jax.process_count(), -1)
 
 
 def hybrid_device_mesh(

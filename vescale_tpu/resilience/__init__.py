@@ -18,20 +18,35 @@ Four pieces, one layer (docs/resilience.md):
      newest committed checkpoint, corrupt-checkpoint quarantine, anomaly
      guard (NaN/skip/z-spike -> rollback, replay-then-skip), bounded
      in-process restarts with backoff.
+  5. **watchdog** (watchdog.py): per-host heartbeat with a step-progress
+     deadline — a hang (wedged collective, dead peer) becomes an
+     all-thread stack dump + flight record + optional abort instead of a
+     silent infinite stall; ``distributed.barrier``/``all_processes_ok``
+     grow ``timeout_s`` (``VESCALE_BARRIER_TIMEOUT``) raising
+     ``BarrierTimeout`` at explicit sync points.
+  6. **consistency** (consistency.py): cross-rank desync detection —
+     cheap all-gathered fingerprints (step/RNG/loader position/replicated
+     param sample/tree structure) raising ``DesyncError`` before a
+     divergent rank can poison the next save; ``run_resilient`` runs the
+     coordinated multi-host protocol on top (agreed preemption, two-phase
+     next-boundary commits, common rollback targets).
 
-All recovery events surface as ``resilience_*`` counters in the telemetry
-registry (rendered as the ``resilience:`` dashboard block) and as event
-lines in ``steps.jsonl``.
+All recovery events surface as ``resilience_*`` / ``consistency_*``
+counters in the telemetry registry (rendered as the ``resilience:``
+dashboard block) and as event lines in ``steps.jsonl``.
 """
 
-from . import faultsim
+from . import consistency, faultsim
+from .consistency import ConsistencyChecker, DesyncError
 from .faultsim import Fault, FaultInjector, arm_from_env, parse_schedule
 from .loop import AnomalyPolicy, RunResult, run_resilient
 from .preempt import PreemptionHandler
 from .retry import RetryPolicy, ckpt_policy, loader_policy, reset_default_policies
+from .watchdog import Watchdog
 
 __all__ = [
     "faultsim",
+    "consistency",
     "Fault",
     "FaultInjector",
     "parse_schedule",
@@ -44,4 +59,7 @@ __all__ = [
     "AnomalyPolicy",
     "RunResult",
     "run_resilient",
+    "Watchdog",
+    "ConsistencyChecker",
+    "DesyncError",
 ]

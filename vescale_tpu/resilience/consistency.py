@@ -1,0 +1,303 @@
+"""Cross-rank desync detection — cheap fingerprints, loud mismatches.
+
+SPMD training is correct only while every process runs the SAME program
+over the same host-side state: step counter, RNG stream, data position,
+replicated parameters, mesh/plan shape.  A rank that silently drifts (bit
+flip in host memory, a rank-local retry that consumed an extra batch, a
+filesystem that fed one rank a stale file) does not crash — it keeps
+issuing collectives that still pair up, and the first hard evidence is a
+corrupted checkpoint that LOOKS committed (arXiv:2004.13336's sharded
+state makes one divergent rank's shard poison the whole save).
+
+This module makes drift a detectable, attributable error: every rank
+computes a small int64 fingerprint vector of its host-side state, the
+vectors are all-gathered (``distributed.allgather_ints``), and any
+field-wise mismatch raises ``DesyncError`` naming the field and every
+rank's value — BEFORE the next save can commit divergent state.
+
+Fingerprint fields (one int64 each, ``FIELDS`` order):
+
+  magic       schema version constant — catches mixed-code-version runs
+  step        next training step counter
+  data_cursor next batch index
+  rng_seed    the run's RNG seed (-1: unseeded)
+  loader      hash of the loader's rank-INVARIANT position state
+              (``batches_served``/``batch``/``seq_len``/``seed``/
+              ``dp_world`` — ``dp_rank`` legitimately differs per rank)
+  structure   hash of the params/opt-state tree STRUCTURE: treedef, leaf
+              shapes, dtypes, shardings/specs — catches mesh/plan drift
+  params      hash of a strided value sample of process-REPLICATED leaves
+              (every rank holds an identical copy by construction, so any
+              difference is real divergence; rank-sharded leaves hold
+              legitimately different bytes and contribute to ``structure``
+              only)
+  extra       caller-provided discriminator (0 default)
+
+Single-process: checks short-circuit to success (no collective), so the
+same code path runs everywhere and tier-1 covers the fingerprint logic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "FIELDS",
+    "MAGIC",
+    "DesyncError",
+    "tree_structure_fingerprint",
+    "replicated_sample_fingerprint",
+    "fingerprint",
+    "compare_rows",
+    "check",
+    "ConsistencyChecker",
+]
+
+# field order of the fingerprint vector; MAGIC bumps on schema change so
+# ranks running different code versions mismatch on field 0, loudly
+FIELDS = ("magic", "step", "data_cursor", "rng_seed", "loader", "structure", "params", "extra")
+MAGIC = 0x7E5CA1E_01  # "vescale" + schema version
+
+
+def _h64(parts: Sequence[Any]) -> int:
+    """Stable 63-bit hash of a sequence of stringable parts (blake2b —
+    process-salt-free, unlike ``hash``)."""
+    h = hashlib.blake2b(digest_size=8)
+    for p in parts:
+        h.update(repr(p).encode())
+        h.update(b"\x00")
+    return int.from_bytes(h.digest(), "little") & 0x7FFFFFFFFFFFFFFF
+
+
+def _mask(v: int) -> int:
+    return int(v) & 0x7FFFFFFFFFFFFFFF
+
+
+def _replicated_host_value(leaf) -> Optional[np.ndarray]:
+    """The leaf's full value as a host array IFF every process provably
+    holds an identical copy; None otherwise.  Never gathers — a fingerprint
+    must stay cheap and collective-free."""
+    import jax
+
+    from ..darray import DArray
+
+    if isinstance(leaf, (bool, int, float)):
+        return np.asarray(leaf)
+    if isinstance(leaf, np.ndarray):
+        return leaf
+    if isinstance(leaf, DArray):
+        from ..placements import Replicate
+
+        if all(isinstance(p, Replicate) for p in leaf.placements):
+            try:
+                return np.asarray(leaf.to_local())
+            except Exception:
+                return None
+        return None
+    if isinstance(leaf, jax.Array):
+        try:
+            if not leaf.sharding.is_fully_replicated:
+                return None
+            shards = leaf.addressable_shards
+            if not shards:
+                return None
+            return np.asarray(shards[0].data)
+        except Exception:
+            return None
+    return None
+
+
+def _leaf_structure(leaf) -> tuple:
+    import jax
+
+    from ..darray import DArray
+
+    if isinstance(leaf, DArray):
+        return ("darray", tuple(leaf.shape), str(leaf.dtype), str(leaf.spec))
+    if isinstance(leaf, jax.Array):
+        try:
+            sh = str(leaf.sharding)
+        except Exception:
+            sh = "?"
+        return ("jax", tuple(leaf.shape), str(leaf.dtype), sh)
+    if isinstance(leaf, np.ndarray):
+        return ("np", tuple(leaf.shape), str(leaf.dtype))
+    return ("py", type(leaf).__name__)
+
+
+def tree_structure_fingerprint(*trees) -> int:
+    """Hash of the trees' STRUCTURE: treedefs + per-leaf shape/dtype/
+    sharding.  Catches a rank building a different mesh, plan, or state
+    schema — the desyncs that corrupt checkpoints without ever producing
+    a NaN."""
+    import jax
+
+    parts: List[Any] = []
+    for tree in trees:
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        parts.append(str(treedef))
+        parts.extend(_leaf_structure(l) for l in leaves)
+    return _h64(parts)
+
+
+def replicated_sample_fingerprint(*trees, sample_stride: int = 4097) -> int:
+    """Hash of a strided value sample of every process-replicated leaf.
+    ``sample_stride`` keeps the host transfer tiny (a few elements per
+    leaf); prime-ish so it does not alias layout periods.  Non-finite
+    values hash by position (NaN != NaN would make every fingerprint
+    unique)."""
+    import jax
+
+    parts: List[Any] = []
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            host = _replicated_host_value(leaf)
+            if host is None:
+                continue
+            flat = np.asarray(host).reshape(-1)
+            sample = flat[:: max(1, sample_stride)]
+            with np.errstate(all="ignore"):
+                # nan_to_num: NaN != NaN would make every fingerprint
+                # unique; a NaN burst still changes the hash (to the
+                # canonical 0 at that position) so divergence shows
+                finite = np.nan_to_num(sample.astype(np.float64, copy=False))
+            parts.append(finite.tobytes())
+            parts.append(sample.shape)
+    return _h64(parts)
+
+
+def _loader_fingerprint(loader_state: Optional[Dict[str, int]]) -> int:
+    if not loader_state:
+        return 0
+    # dp_rank differs per rank BY DESIGN (each rank reads its own stream
+    # slice); everything else must agree
+    inv = {k: int(v) for k, v in sorted(loader_state.items()) if k != "dp_rank"}
+    return _h64(sorted(inv.items()))
+
+
+def fingerprint(
+    *,
+    step: int,
+    data_cursor: int = 0,
+    rng_seed: Optional[int] = None,
+    loader_state: Optional[Dict[str, int]] = None,
+    params: Any = None,
+    opt_state: Any = None,
+    sample_stride: int = 4097,
+    extra: int = 0,
+) -> np.ndarray:
+    """This rank's fingerprint vector (int64, ``FIELDS`` order)."""
+    trees = [t for t in (params, opt_state) if t is not None]
+    return np.asarray(
+        [
+            MAGIC,
+            int(step),
+            int(data_cursor),
+            _mask(rng_seed) if rng_seed is not None else -1,
+            _loader_fingerprint(loader_state),
+            tree_structure_fingerprint(*trees) if trees else 0,
+            replicated_sample_fingerprint(*trees, sample_stride=sample_stride)
+            if trees
+            else 0,
+            _mask(extra),
+        ],
+        np.int64,
+    )
+
+
+class DesyncError(RuntimeError):
+    """Ranks disagree on state that must be identical.  Carries the full
+    all-gathered matrix so the error message (and forensics) name WHICH
+    field diverged and every rank's value — the difference between "the
+    job died" and "rank 3 is one batch ahead"."""
+
+    def __init__(self, mismatched: Dict[str, List[int]], rows: np.ndarray):
+        self.mismatched = mismatched
+        self.rows = rows
+        detail = "; ".join(
+            f"{field}: " + ", ".join(f"rank{r}={v}" for r, v in enumerate(vals))
+            for field, vals in mismatched.items()
+        )
+        super().__init__(
+            f"cross-rank desync detected on {sorted(mismatched)} — {detail}"
+        )
+
+
+def compare_rows(rows: np.ndarray, fields: Sequence[str] = FIELDS) -> Dict[str, List[int]]:
+    """Field-wise mismatch map of an all-gathered fingerprint matrix
+    (rank-major rows); empty when every rank agrees."""
+    rows = np.asarray(rows)
+    out: Dict[str, List[int]] = {}
+    for i, name in enumerate(fields[: rows.shape[1]]):
+        col = rows[:, i]
+        if not np.all(col == col[0]):
+            out[name] = [int(v) for v in col]
+    return out
+
+
+def check(
+    fp: np.ndarray,
+    tag: str = "resilience_consistency",
+    timeout_s: Optional[float] = None,
+) -> np.ndarray:
+    """All-gather this rank's fingerprint and verify every rank matches.
+    Raises ``DesyncError`` on mismatch (symmetric: every rank sees the
+    same gathered matrix, so every rank raises).  Single-process: the
+    fingerprint is trivially consistent.  Returns the gathered matrix."""
+    from .. import telemetry as _tel
+    from ..distributed import allgather_ints
+
+    rows = allgather_ints(fp, tag=tag, timeout_s=timeout_s)
+    _tel.count("consistency_checks_total")
+    mismatched = compare_rows(rows)
+    if mismatched:
+        _tel.count("consistency_mismatches_total")
+        _tel.record_event(
+            "resilience_desync",
+            fields=sorted(mismatched),
+            rows={f: v for f, v in mismatched.items()},
+        )
+        raise DesyncError(mismatched, rows)
+    return rows
+
+
+class ConsistencyChecker:
+    """Cadenced fingerprint checks for a training loop.
+
+        checker = ConsistencyChecker(every=32)
+        ...
+        checker.maybe_check(step, params=params, opt_state=opt,
+                            data_cursor=cursor, rng_seed=seed,
+                            loader_state=loader.state())
+
+    ``every`` trades detection latency against the (tiny) allgather cost;
+    ``run_resilient`` aligns its own control-plane exchange with this
+    cadence so desync is caught before the next checkpoint save commits."""
+
+    def __init__(
+        self,
+        every: int = 32,
+        sample_stride: int = 4097,
+        timeout_s: Optional[float] = None,
+    ):
+        if every <= 0:
+            raise ValueError("ConsistencyChecker every must be positive")
+        self.every = int(every)
+        self.sample_stride = int(sample_stride)
+        self.timeout_s = timeout_s
+        self.checks = 0
+
+    def due(self, step: int) -> bool:
+        return step % self.every == 0
+
+    def fingerprint(self, step: int, **state) -> np.ndarray:
+        return fingerprint(step=step, sample_stride=self.sample_stride, **state)
+
+    def maybe_check(self, step: int, **state) -> Optional[np.ndarray]:
+        if not self.due(step):
+            return None
+        self.checks += 1
+        return check(self.fingerprint(step, **state), timeout_s=self.timeout_s)

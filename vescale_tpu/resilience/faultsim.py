@@ -16,10 +16,13 @@ so even "random" faults replay exactly.  The schedule comes from
 runs:
 
     VESCALE_FAULTSIM="storage_write:call=3;preempt:step=10;nonfinite_loss:step=6,count=4"
+    VESCALE_FAULTSIM="storage_write:step=3,rank=1"     # only process 1 fails
 
 Grammar: ``kind:key=value[,key=value...]`` joined by ``;`` where keys are
 ``call`` (0-based per-kind call index), ``step``, ``count`` (default 1),
-``p`` (probability per call) and ``seed``.
+``p`` (probability per call), ``seed`` and ``rank`` (restrict firing to
+one process — the same schedule text armed on every process injects the
+fault on exactly that rank, the multi-host failure-path substrate).
 
 Fault kinds and their hook sites:
 
@@ -35,6 +38,10 @@ Fault kinds and their hook sites:
   preempt           sets the run's preemption stop flag (as if SIGTERM)
   oom               ``RuntimeError("RESOURCE_EXHAUSTED...")`` around the
                     train step (exercises flight recorder + restart path)
+  hang              observed by ``run_resilient`` — the step boundary
+                    sleeps ``VESCALE_FAULTSIM_HANG_S`` (default 3600)
+                    seconds, simulating a wedged collective so the
+                    watchdog's detect/dump/abort path is exercisable
   ================  ====================================================
 
 Gating contract (the ``telemetry.init()`` pattern): while disarmed the
@@ -75,6 +82,7 @@ KINDS = (
     "nonfinite_loss",
     "preempt",
     "oom",
+    "hang",
 )
 
 # errors raised by `check` per kind; observation-level kinds (nonfinite_loss,
@@ -98,11 +106,30 @@ def _splitmix64(x: int) -> int:
     return x ^ (x >> 31)
 
 
+def _process_rank() -> int:
+    """This process's rank for the ``rank=`` selector.  Prefers the env
+    bootstrap (set before jax initializes in spawned-worker rigs) so a
+    schedule can be parsed and filtered without touching jax; falls back
+    to ``jax.process_index()``."""
+    env = os.environ.get("VESCALE_PROCESS_ID")
+    if env is not None:
+        return int(env)
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
 @dataclass
 class Fault:
     """One scheduled fault.  Exactly one trigger: ``at_call`` (0-based
     per-kind call index), ``at_step`` (training step, via ``set_step``), or
-    ``p`` (seeded per-call probability).  ``count`` consecutive firings."""
+    ``p`` (seeded per-call probability).  ``count`` consecutive firings.
+    ``rank`` (optional) restricts firing to one process — the selector that
+    makes MULTI-process failure paths injectable (one rank's storage dies,
+    one rank hangs, one rank's RNG skews) while the peers stay healthy."""
 
     kind: str
     at_call: Optional[int] = None
@@ -110,6 +137,7 @@ class Fault:
     p: float = 0.0
     seed: int = 0
     count: int = 1
+    rank: Optional[int] = None
     fired: int = field(default=0, init=False)
 
     def __post_init__(self):
@@ -122,6 +150,10 @@ class Fault:
             )
 
     def should_fire(self, call_index: int, step: Optional[int]) -> bool:
+        # rank selector is a FILTER, not a trigger: the schedule text is
+        # identical on every process, only the selected rank fires
+        if self.rank is not None and self.rank != _process_rank():
+            return False
         # a fault fires at most `count` times TOTAL: a step-keyed fault that
         # re-fired when the recovery loop replays the same step would make
         # every rollback loop forever (transient-fault semantics)
@@ -245,7 +277,7 @@ def parse_schedule(text: str) -> List[Fault]:
             for kv in argstr.split(","):
                 k, _, v = kv.partition("=")
                 k = k.strip()
-                if k not in ("call", "step", "count", "p", "seed"):
+                if k not in ("call", "step", "count", "p", "seed", "rank"):
                     raise ValueError(f"faultsim spec {part!r}: unknown key {k!r}")
                 kwargs[k] = float(v) if k == "p" else int(v)
         faults.append(
@@ -256,6 +288,7 @@ def parse_schedule(text: str) -> List[Fault]:
                 p=float(kwargs.get("p", 0.0)),
                 seed=int(kwargs.get("seed", 0)),
                 count=int(kwargs.get("count", 1)),
+                rank=int(kwargs["rank"]) if "rank" in kwargs else None,
             )
         )
     return faults

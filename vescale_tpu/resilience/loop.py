@@ -27,6 +27,45 @@ the optimizer's skip-on-nonfinite signal, and the OOM flight recorder
                          flight-record, back off, restore, retry — up to
                          ``max_restarts`` in-process restarts.
 
+Multi-host (``jax.process_count() > 1``) adds the coordinated layer — the
+failures that dominate production SPMD runs are CROSS-rank (PAPER.md /
+arXiv:1811.02084-scale: one stuck rank stalls every healthy one forever;
+arXiv:2004.13336's sharded state lets one divergent rank poison a
+checkpoint that looks committed):
+
+  hang                   per-host watchdog (resilience/watchdog.py): no
+                         step progress within the deadline -> all-thread
+                         stack dump + flight record + (optional) abort so
+                         the external restart path takes over; barriers
+                         and votes carry ``VESCALE_BARRIER_TIMEOUT`` so a
+                         dead peer raises ``BarrierTimeout`` instead of
+                         blocking.
+  desync                 per-step control-plane exchange (one tiny
+                         allgather: step counter, preempt flag, anomaly
+                         streak) plus a cadenced consistency fingerprint
+                         (resilience/consistency.py: RNG seed, loader
+                         position, replicated-param sample, tree/mesh
+                         structure) — any mismatch raises ``DesyncError``
+                         on EVERY rank before the next save can commit
+                         divergent state.
+  torn commit            two-phase: every rank votes on its shard writes
+                         (``all_processes_ok``) before process 0 writes
+                         ``meta.json`` or rotation prunes anything; an
+                         async save is committed at the NEXT step boundary
+                         (one step of write/compute overlap).  A failed
+                         vote means the step is committed NOWHERE and the
+                         run continues to the next save.
+  partial preemption     any rank's preemption flag is agreed via the
+                         control exchange: all ranks drain, emergency-save
+                         (two-phase), and exit "preempted" together.
+  rollback agreement     restore targets come from
+                         ``CheckpointManager.latest_common_step`` (the
+                         newest step committed on ALL ranks), so ranks can
+                         never roll back to different steps; a step
+                         exception is fatal in coordinated mode (peers may
+                         be wedged mid-collective — only a process-level
+                         restart is safe, and auto-resume makes it cheap).
+
 Every recovery event surfaces as a ``resilience_*`` counter in the
 telemetry registry (exporters render them as the ``resilience:`` dashboard
 block) and as an event line in ``steps.jsonl``.
@@ -41,15 +80,24 @@ same program on the same data from checkpoint-roundtripped state
 from __future__ import annotations
 
 import math
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
+from . import consistency as _cons
 from . import faultsim as _fs
 from .preempt import PreemptionHandler
+from .watchdog import Watchdog
 
 __all__ = ["AnomalyPolicy", "RunResult", "run_resilient"]
+
+# control-plane vector: [magic, step, preempt, bad_streak, rollbacks,
+# fp_due, <consistency fingerprint fields when fp_due>].  Exchanged every
+# step in coordinated mode; preempt is an OR, everything else must agree.
+_COORD_MAGIC = 0x7E5C0
+_COORD_FIELDS = ("coord_magic", "step", "preempt", "bad_streak", "rollbacks", "fp_due")
 
 
 @dataclass
@@ -115,6 +163,12 @@ def run_resilient(
     preemption: Optional[PreemptionHandler] = None,
     install_signal_handlers: bool = True,
     on_step: Optional[Callable[[int, float], None]] = None,
+    watchdog: Optional[Watchdog] = None,
+    watchdog_timeout_s: Optional[float] = None,
+    consistency: Optional[_cons.ConsistencyChecker] = None,
+    consistency_every: Optional[int] = None,
+    coordinate: Optional[bool] = None,
+    barrier_timeout_s: Optional[float] = None,
 ) -> RunResult:
     """Run ``total_steps`` training steps with automatic recovery.
 
@@ -136,9 +190,21 @@ def run_resilient(
     never saved CANNOT be restarted in-process after a step exception
     (the pre-step state is gone once the step ran) — save early.
 
+    Multi-host: with ``jax.process_count() > 1`` (or ``coordinate=True``)
+    the loop runs the coordinated protocol described in the module
+    docstring — per-step control exchange, agreed preemption, common
+    restore targets, next-boundary two-phase commits, consistency checks
+    every ``consistency_every`` steps (env ``VESCALE_CONSISTENCY_EVERY``,
+    default 32), and NO in-process step-exception restarts (a peer may be
+    wedged mid-collective; abort and auto-resume instead).  ``watchdog``/
+    ``watchdog_timeout_s`` (env ``VESCALE_WATCHDOG_TIMEOUT``) arm the hang
+    watchdog; ``barrier_timeout_s`` (env ``VESCALE_BARRIER_TIMEOUT``)
+    bounds every coordination collective.
+
     NOTE: the anomaly guard reads the loss on the host every step (the
     same sync ``telemetry.record_step`` opts into); ``VESCALE_BENCH=
-    resilience`` measures the armed-but-quiescent overhead."""
+    resilience`` / ``VESCALE_BENCH=watchdog`` measure the
+    armed-but-quiescent overhead."""
     if (loader is None) == (batch_fn is None):
         raise ValueError("exactly one of loader / batch_fn is required")
     if total_steps <= 0:
@@ -155,6 +221,36 @@ def run_resilient(
     own_handler = preemption is None
     if own_handler and install_signal_handlers:
         handler.install()
+
+    coord = (jax.process_count() > 1) if coordinate is None else bool(coordinate)
+
+    # ------------------------------------------------- watchdog arming
+    own_wd = False
+    wd = watchdog
+    if wd is None:
+        # param deadline overrides the env one (0 = explicit off);
+        # abort/exit-code always come from the env (one parser: from_env)
+        wd = Watchdog.from_env(timeout_s=watchdog_timeout_s)
+        own_wd = wd is not None
+    if own_wd:
+        wd.start()
+
+    def _beat(at_step: int, phase: str = "step") -> None:
+        if wd is not None:
+            wd.beat(at_step, phase=phase)
+
+    # ---------------------------------------------- consistency arming
+    checker = consistency
+    if checker is None:
+        env_every = os.environ.get("VESCALE_CONSISTENCY_EVERY")
+        n = consistency_every if consistency_every is not None else (
+            int(env_every) if env_every else 32
+        )
+        # single-process fingerprints detect nothing (there is no peer to
+        # disagree with) — armed by default only when coordinating, or on
+        # explicit request (param / env), so bare runs pay zero
+        if n > 0 and (coord or consistency_every is not None or env_every):
+            checker = _cons.ConsistencyChecker(every=n, timeout_s=barrier_timeout_s)
 
     base_key = jax.random.PRNGKey(rng_seed) if rng_seed is not None else None
 
@@ -188,16 +284,89 @@ def run_resilient(
     def _event(kind: str, **fields) -> None:
         _tel.record_event(f"resilience_{kind}", **fields)
 
+    def _latest() -> Optional[int]:
+        """The newest restorable step: committed on ALL ranks when
+        coordinating (ranks restoring different steps is a guaranteed
+        desync), plain latest otherwise."""
+        if coord:
+            return manager.latest_common_step(timeout_s=barrier_timeout_s)
+        return manager.latest_step()
+
+    def _coordinate() -> bool:
+        """One control-plane allgather: agree on preemption, verify the
+        ranks are marching in lockstep, and (on the consistency cadence)
+        compare state fingerprints.  Returns the AGREED preemption flag;
+        raises ``DesyncError`` on any disagreement — symmetric on every
+        rank, and always BEFORE the next save could commit divergent
+        state."""
+        from ..distributed import allgather_ints
+
+        fp = None
+        if checker is not None and checker.due(step):
+            checker.checks += 1
+            fp = checker.fingerprint(
+                step,
+                data_cursor=data_cursor,
+                rng_seed=rng_seed,
+                loader_state=loader.state() if loader is not None else None,
+                params=result.params,
+                opt_state=result.opt_state,
+            )
+        vec = [
+            _COORD_MAGIC,
+            step,
+            1 if handler.requested() else 0,
+            bad_streak,
+            result.rollbacks,
+            0 if fp is None else 1,
+        ]
+        # FIXED width always: ranks disagreeing on the fingerprint cadence
+        # (the desync case itself) must exchange same-shape rows so the
+        # mismatch surfaces as a named DesyncError on fp_due/step, not as
+        # an opaque shape error inside the collective
+        vec.extend(int(v) for v in fp) if fp is not None else vec.extend(
+            [0] * len(_cons.FIELDS)
+        )
+        rows = allgather_ints(vec, tag="resilience_coord", timeout_s=barrier_timeout_s)
+        if rows.shape[0] == 1:
+            # coordinate=True on one process (tests, bench): a single row
+            # cannot mismatch — skip the compares, keep the counters honest
+            if fp is not None:
+                _tel.count("consistency_checks_total")
+            return bool(vec[2])
+        preempt_any = bool(rows[:, 2].any())
+        mismatched = _cons.compare_rows(rows[:, : len(_COORD_FIELDS)], _COORD_FIELDS)
+        mismatched.pop("preempt", None)  # an OR, not an agreement
+        if not mismatched and fp is not None:
+            _tel.count("consistency_checks_total")
+            mismatched = _cons.compare_rows(rows[:, len(_COORD_FIELDS) :], _cons.FIELDS)
+        if mismatched:
+            _tel.count("consistency_mismatches_total")
+            _event("desync", at_step=step, fields=sorted(mismatched))
+            _memtrack.dump_now(reason=f"desync@step{step}")
+            # quarantine the run: raising here (on every rank — the
+            # gathered matrix is identical everywhere) guarantees no
+            # further save can commit divergent state
+            raise _cons.DesyncError(mismatched, rows)
+        if preempt_any and not handler.requested():
+            handler.request()  # a PEER was preempted; we drain with it
+        return preempt_any
+
     def _restore_latest() -> Optional[int]:
         """Restore the newest committed checkpoint, quarantining any that
         commit but will not load.  Returns the restored step or None.
-        Mutates result.params/opt_state, step, data_cursor, loader."""
+        Mutates result.params/opt_state, step, data_cursor, loader.
+        Coordinated mode: the target comes from ``latest_common_step`` and
+        per-target restore success is VOTED, so a rank-local read failure
+        quarantines the step on every rank together (ranks falling back to
+        different steps would desync)."""
         nonlocal step, data_cursor
         while True:
-            target = manager.latest_step()
+            target = _latest()
             if target is None:
                 return None
             template = _ckpt_state(0)
+            restore_err: Optional[Exception] = None
             try:
                 restored = manager.restore(template, step=target)
             except KeyError as e:
@@ -212,22 +381,37 @@ def run_resilient(
                     "structurally incompatible (not corrupt) checkpoint — "
                     "restore it manually or resume with matching state"
                 ) from e
-            except Exception as e:  # corrupt-but-committed: quarantine, go older
+            except Exception as e:  # corrupt-but-committed on THIS rank
+                restore_err = e
+                restored = None
+            ok = restore_err is None
+            if coord:
+                # restore success is voted: a rank-local read failure must
+                # quarantine the step EVERYWHERE (the healthy ranks discard
+                # their successful load) or ranks would restore different
+                # steps — the desync this whole layer exists to prevent
+                from ..distributed import all_processes_ok
+
+                ok = all_processes_ok(
+                    ok, tag=f"resilience_restore:{target}", timeout_s=barrier_timeout_s
+                )
+            if not ok:
+                err = repr(restore_err) if restore_err is not None else "peer restore failure"
                 result.quarantined += 1
                 dst = manager.quarantine(target)
                 if dst is None:
                     # rename failed (read-only root?): without it the same
                     # step stays newest-committed and this loop would spin
                     raise RuntimeError(
-                        f"checkpoint step {target} is unloadable ({e!r}) and "
+                        f"checkpoint step {target} is unloadable ({err}) and "
                         "could not be quarantined; aborting restore"
-                    ) from e
-                _event("quarantine", ckpt_step=target, path=dst, error=repr(e))
+                    ) from restore_err
+                _event("quarantine", ckpt_step=target, path=dst, error=err)
                 import warnings
 
                 warnings.warn(
                     f"checkpoint step {target} is committed but unloadable "
-                    f"({e!r}); quarantined to {dst} — trying the next-older "
+                    f"({err}); quarantined to {dst} — trying the next-older "
                     "committed step",
                     stacklevel=2,
                 )
@@ -267,13 +451,37 @@ def run_resilient(
         _tel.count("resilience_resumes_total")
         _event("resume", ckpt_step=resumed)
 
+    commit_due = False  # coordinated mode: an async save awaiting its vote
     try:
         while True:
-            # ---------------------------------------------- preemption gate
+            # ---------------------------------------------- step-boundary gate
             _fs.set_step(step)
+            _beat(step)
+            if _fs.fires("hang", ctx=f"step{step}"):
+                # simulated wedged collective: stall far past any deadline —
+                # the watchdog's detect/dump/abort path is the way out
+                time.sleep(float(os.environ.get("VESCALE_FAULTSIM_HANG_S", "3600")))
             if _fs.fires("preempt", ctx=f"step{step}"):
                 handler.request()
-            if handler.requested():
+            # coordinated mode: one control-plane allgather — agreed
+            # preemption, lockstep verification, cadenced fingerprints
+            if coord:
+                preempt_now = _coordinate()
+            else:
+                # an explicitly-armed checker still runs its cadence
+                # (trivially consistent alone, but the counters stay honest
+                # and the fingerprint computation is validated)
+                if checker is not None and checker.due(step):
+                    checker.maybe_check(
+                        step,
+                        data_cursor=data_cursor,
+                        rng_seed=rng_seed,
+                        loader_state=loader.state() if loader is not None else None,
+                        params=result.params,
+                        opt_state=result.opt_state,
+                    )
+                preempt_now = handler.requested()
+            if preempt_now:
                 result.status = "preempted"
                 _tel.count("resilience_preemptions_total")
                 # no emergency save mid-anomaly-streak: result.params may be
@@ -282,7 +490,8 @@ def run_resilient(
                 # good one instead — same rule as the periodic save)
                 if result.step >= 0 and bad_streak == 0:
                     manager.wait_pending()  # drain in-flight async saves
-                    if manager.latest_step() != result.step:
+                    if _latest() != result.step:
+                        _beat(step, "emergency_save")
                         _save(result.step, sync=True)
                         _tel.count("resilience_emergency_saves_total")
                         result.emergency_save_step = result.step
@@ -297,6 +506,15 @@ def run_resilient(
                 manager.wait_pending()  # the final async save must commit
                 result.status = "completed"
                 return result
+            if commit_due:
+                # two-phase commit of the previous boundary's async save:
+                # handle.wait() runs the all-rank vote + meta.json write on
+                # this thread — one step of write/compute overlap, and a
+                # failed vote means the step committed NOWHERE (the run
+                # continues to the next save)
+                manager.wait_pending()
+                commit_due = False
+                _beat(step, "commit")
 
             # ------------------------------------------------- run one step
             cursor_before = data_cursor
@@ -329,8 +547,16 @@ def run_resilient(
                 handler.request()
                 continue
             except Exception as e:
-                # in-process restart path: flight-record, back off, restore
                 _memtrack.maybe_dump_oom(e)
+                if coord:
+                    # multi-host: peers may be wedged inside the failed
+                    # step's collective — no Python-level restore here can
+                    # reach them, so an in-process restart would desync.
+                    # Abort; the supervisor restarts every rank and
+                    # auto-resume makes it one checkpoint interval cheap.
+                    _event("fatal_step_error", at_step=step, error=repr(e))
+                    raise
+                # in-process restart path: flight-record, back off, restore
                 restart_attempts += 1
                 result.restarts += 1
                 _tel.count("resilience_restarts_total")
@@ -389,12 +615,20 @@ def run_resilient(
                         f"max_rollbacks={pol.max_rollbacks}; giving up"
                     )
                 bad_step = step  # last (anomalous) step that ran
-                if manager.latest_step() is None:
+                if not coord and manager.latest_step() is None:
                     raise RuntimeError(
                         f"anomaly at step {step} but no committed checkpoint "
                         "to roll back to (save_every too large?)"
                     )
                 manager.wait_pending()  # a pending save may hold a bad step
+                if coord and _latest() is None:
+                    # checked AFTER the drain (the drained commit may be the
+                    # only checkpoint) and via the all-rank intersection so
+                    # every rank raises together
+                    raise RuntimeError(
+                        f"anomaly at step {step} but no committed checkpoint "
+                        "to roll back to (save_every too large?)"
+                    )
                 target = _restore_latest()
                 if target is None:
                     # every committed step was quarantined during restore:
@@ -437,11 +671,16 @@ def run_resilient(
             if bad_streak == 0 and (
                 (step + 1) % max(1, save_every) == 0 or step == total_steps - 1
             ):
+                _beat(step, "save")
                 _save(step)
+                if coord and async_save:
+                    commit_due = True  # voted at the next step boundary
                 last_rollback_target = None  # clean committed progress:
                 # the next rollback (if any) restores a NEWER step, so
                 # re-arm replay-first semantics
             step += 1
     finally:
+        if own_wd:
+            wd.stop()
         if own_handler and install_signal_handlers:
             handler.uninstall()

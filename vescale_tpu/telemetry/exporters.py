@@ -146,15 +146,18 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     width = 78
     lines = ["=" * width, f"{title:^{width}}", "=" * width]
     mem_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith("mem_")}
-    res_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith("resilience_")}
+    # consistency_* (cross-rank desync checks) lives in the resilience
+    # block: one recovery-story section, not two
+    _res = ("resilience_", "consistency_")
+    res_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_res)}
     other_gauges = {
         n: v
         for n, v in snap["gauges"].items()
-        if not n.startswith(("mem_", "resilience_"))
+        if not n.startswith(("mem_",) + _res)
     }
-    res_counters = {n: v for n, v in snap["counters"].items() if n.startswith("resilience_")}
+    res_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_res)}
     other_counters = {
-        n: v for n, v in snap["counters"].items() if not n.startswith("resilience_")
+        n: v for n, v in snap["counters"].items() if not n.startswith(_res)
     }
     if other_counters:
         lines.append("counters:")
