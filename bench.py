@@ -45,7 +45,12 @@ def _step_report_line(step, params, opt_state, batch, on_tpu):
     program.  AOT lower+compile is a SECOND compile of the step, so on TPU
     it is opt-in (VESCALE_BENCH_STEP_REPORT=1); on CPU smoke it is cheap and
     on by default.  Never fails the bench — errors degrade to None."""
-    if os.environ.get("VESCALE_BENCH_STEP_REPORT", "0" if on_tpu else "1") != "1":
+    from vescale_tpu.analysis import envreg
+
+    # bool semantics per the registry (doc: unset = on for CPU, off on TPU)
+    if not envreg.coerce_bool(
+        envreg.get_raw("VESCALE_BENCH_STEP_REPORT"), default=not on_tpu
+    ):
         return None
     try:
         from vescale_tpu.telemetry.step_report import build_step_report
@@ -623,7 +628,9 @@ def main():
     n = len(devices)
     on_tpu = devices[0].platform == "tpu"
 
-    rung = os.environ.get("VESCALE_BENCH_RUNG", "1.3b")
+    from vescale_tpu.analysis import envreg
+
+    rung = envreg.get_str("VESCALE_BENCH_RUNG")
     if on_tpu and rung == "350m":
         # fallback rung when the 1.3B child fails on the live chip (OOM /
         # flaky tunnel mid-run): the round-1 driver-verified config — a
@@ -714,8 +721,10 @@ def main():
 
 
 def _dispatch():
+    from vescale_tpu.analysis import envreg
+
     _register_holder()  # make this child killable by future orchestrators
-    which = os.environ.get("VESCALE_BENCH")
+    which = envreg.get_str("VESCALE_BENCH")
     if which == "moe":
         bench_moe()
     elif which == "longctx":
@@ -766,7 +775,9 @@ def _register_holder() -> None:
     when it spawned and must be dead now, or we could not hold it."""
     import atexit
 
-    if os.environ.get("VESCALE_BENCH_NO_REGISTER"):
+    from vescale_tpu.analysis import envreg
+
+    if envreg.get_bool("VESCALE_BENCH_NO_REGISTER"):
         return
     os.makedirs(HOLDERS_DIR, exist_ok=True)
     path = os.path.join(HOLDERS_DIR, str(os.getpid()))
@@ -920,7 +931,9 @@ LASTGOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "TPU_LA
 
 
 def _bench_mode() -> str:
-    return os.environ.get("VESCALE_BENCH") or "default"
+    from vescale_tpu.analysis import envreg
+
+    return envreg.get_str("VESCALE_BENCH") or "default"
 
 
 def _read_lastgood_file() -> dict:
@@ -962,7 +975,9 @@ def _orchestrate() -> int:
     (round-2 BENCH_r02 rc=1) cannot cost the round its perf number.  Budget-
     bounded; final fallback emits an honestly-labelled CPU line so the driver
     always records parseable output."""
-    budget = float(os.environ.get("VESCALE_BENCH_BUDGET_S", "1200"))
+    # orchestrator PARENT path: stays stdlib-light on purpose (it only
+    # supervises children; importing the package here would pull in jax)
+    budget = float(os.environ.get("VESCALE_BENCH_BUDGET_S", "1200"))  # vescale-lint: disable=VSC201 (parent stays import-light)
     deadline = time.time() + budget
     cpu_reserve = 240.0  # leave room for the CPU fallback rung
     have_lock = _acquire_orchestrator_lock()
@@ -988,7 +1003,7 @@ def _orchestrate() -> int:
         # rung — a fresh small number beats no fresh number.  Only the
         # default llama bench reads VESCALE_BENCH_RUNG: for moe/longctx a
         # "fallback" would silently re-run the identical failing config.
-        fallback_ok = not os.environ.get("VESCALE_BENCH")
+        fallback_ok = not os.environ.get("VESCALE_BENCH")  # vescale-lint: disable=VSC201 (parent stays import-light)
         rung = "350m" if fallback_ok and tpu_children_failed >= 2 else None
         line = _run_child(deadline - cpu_reserve, rung=rung)
         if line is not None:
@@ -1016,7 +1031,7 @@ def _orchestrate() -> int:
 
 
 if __name__ == "__main__":
-    if os.environ.get("VESCALE_BENCH_CHILD"):
+    if os.environ.get("VESCALE_BENCH_CHILD"):  # vescale-lint: disable=VSC201 (parent stays import-light)
         _dispatch()
     else:
         sys.exit(_orchestrate())

@@ -24,7 +24,9 @@ import jax
 # demo-safe default: run on CPU unless explicitly asked for the real chip
 # (probing the default backend first would hang forever on a sick TPU
 # plugin — the round-2 failure mode bench.py guards against)
-if os.environ.get("VESCALE_FP8_ON_TPU", "0").lower() in ("", "0", "false"):
+from vescale_tpu.analysis import envreg  # noqa: E402
+
+if not envreg.get_bool("VESCALE_FP8_ON_TPU"):
     jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp

@@ -64,8 +64,15 @@ import subprocess
 import sys
 import time
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+# importing envreg pulls in the vescale_tpu package (and jax) — a few
+# seconds of parent-process overhead before the _reexec, accepted for the
+# typed/registered knob reads (backends stay uninitialized, so the child's
+# XLA_FLAGS still govern)
+from vescale_tpu.analysis import envreg  # noqa: E402
+
 # Model rung: VESCALE_AOT_MODEL=8b (default) | 70b | 405b | mixtral.
-MODEL = os.environ.get("VESCALE_AOT_MODEL", "8b")
+MODEL = envreg.get_str("VESCALE_AOT_MODEL")
 if MODEL not in ("8b", "70b", "405b", "mixtral"):
     raise SystemExit(
         f"VESCALE_AOT_MODEL={MODEL!r}: expected one of 8b | 70b | 405b | mixtral "
@@ -101,14 +108,11 @@ SEQ = 4096
 # delayed-scaling fp8 (LlamaConfig.use_fp8); the _overwrite_with_gradient
 # scaling state threads through the compile and updates by gradient
 # overwrite — the census artifact VERDICT r4 next #7 asks for
-FP8 = (
-    os.environ.get("VESCALE_AOT_FP8", "0").lower() not in ("", "0", "false")
-    and MODEL == "8b"
-)
+FP8 = envreg.get_bool("VESCALE_AOT_FP8") and MODEL == "8b"
 # VESCALE_AOT_ZB=1: compile the ZERO-BUBBLE pipeline (pipeline_blocks_zb —
 # dgrad/wgrad split custom backward) instead of 1F1B, substantiating the
 # report's zero-bubble MFU point with a real compile
-ZB = os.environ.get("VESCALE_AOT_ZB", "0").lower() not in ("", "0", "false")
+ZB = envreg.get_bool("VESCALE_AOT_ZB")
 
 # ---- documented v5p roofline constants (jax-ml.github.io/scaling-book)
 V5P_BF16_FLOPS = 459e12          # per-chip peak, bf16
@@ -448,7 +452,7 @@ def main():
         mem.argument_size_in_bytes + mem.output_size_in_bytes + mem.temp_size_in_bytes
     )
 
-    if os.environ.get("VESCALE_AOT_DEBUG"):
+    if envreg.get_bool("VESCALE_AOT_DEBUG"):
         # top HLO buffers by bytes — what actually owns the temp memory
         sizes = []
         for m_ in re.finditer(r"^\s*(\S+) = (f32|s32|bf16|u32|pred)\[([\d,]*)\]", hlo, re.M):
@@ -676,6 +680,6 @@ def main():
 
 
 if __name__ == "__main__":
-    if not os.environ.get("VESCALE_AOT_CHILD"):
+    if not envreg.get_bool("VESCALE_AOT_CHILD"):
         _reexec()
     main()

@@ -5,6 +5,9 @@ set -u
 cd "$(dirname "$0")/.."
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 failed=0
+echo "=== vescale-lint + shardcheck smoke (static analysis gate)"
+python -m vescale_tpu.analysis --strict lint || failed=1
+python scripts/shardcheck_smoke.py || failed=1
 for f in tests/test_*.py; do
   echo "=== $f"
   python -m pytest "$f" -q || failed=1

@@ -1,0 +1,81 @@
+"""shardcheck smoke — the tier-1 acceptance run for the analysis layer.
+
+Exercises the full CLI surface end to end in subprocesses (the exact
+commands CI and a user would run):
+
+  1. ``python -m vescale_tpu.analysis --strict demo bad``  MUST exit
+     non-zero and print a materialization code (VSC101) AND the
+     redistribute decline pair (VSC106 + its VSC12x structured reason) —
+     the program that previously hit the logical-materializing fallback
+     is flagged *statically*.
+  2. ``python -m vescale_tpu.analysis --strict demo good`` MUST exit 0
+     with zero findings — strict mode does not cry wolf.
+  3. ``python -m vescale_tpu.analysis lint``               MUST exit 0:
+     the repo holds its own invariants (every VESCALE_* env read through
+     envreg, no unregistered vars, hooks/signal/retry rules).
+  4. ``python -m vescale_tpu.analysis examples``           MUST exit 0:
+     the shipped example training configs are clean.
+  5. The committed docs/configuration.md matches the registry exactly.
+
+Exit code 0 = all gates hold.  Wired into tier-1 via
+tests/test_analysis.py::test_shardcheck_smoke_script.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _run(*argv: str):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.run(
+        [sys.executable, "-m", "vescale_tpu.analysis", *argv],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=480,
+    )
+
+
+def main() -> int:
+    # 1. known-bad: strict mode flags it, with the right codes
+    bad = _run("--strict", "demo", "bad")
+    assert bad.returncode != 0, f"demo bad passed strict mode:\n{bad.stdout}\n{bad.stderr}"
+    for code in ("VSC101", "VSC106", "VSC120"):
+        assert code in bad.stdout, f"{code} missing from demo-bad output:\n{bad.stdout}"
+    print("[smoke] demo bad: strict exit", bad.returncode, "with VSC101/VSC106/VSC120  OK")
+
+    # 2. known-good: strict mode stays silent
+    good = _run("--strict", "demo", "good")
+    assert good.returncode == 0, f"demo good failed strict mode:\n{good.stdout}\n{good.stderr}"
+    assert "0 findings" in good.stdout
+    print("[smoke] demo good: strict exit 0, clean  OK")
+
+    # 3. the repo lints green
+    lint = _run("--strict", "lint")
+    assert lint.returncode == 0, f"vescale-lint found violations:\n{lint.stdout}\n{lint.stderr}"
+    print("[smoke] lint: clean  OK")
+
+    # 4. examples/ training configs are clean under strict
+    ex = _run("--strict", "examples")
+    assert ex.returncode == 0, f"examples validation failed:\n{ex.stdout}\n{ex.stderr}"
+    print("[smoke] examples: clean  OK")
+
+    # 5. generated configuration doc is in sync
+    from vescale_tpu.analysis.envreg import configuration_markdown
+
+    with open(os.path.join(REPO, "docs", "configuration.md"), encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == configuration_markdown(), (
+        "docs/configuration.md is stale — regenerate with "
+        "`python -m vescale_tpu.analysis envdoc --write docs/configuration.md`"
+    )
+    print("[smoke] docs/configuration.md: in sync  OK")
+    print("[smoke] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+"""vescale_tpu.analysis — the static-analysis layer.
+
+Two engines, one findings model (docs/observability.md "Static analysis"):
+
+  * **shardcheck** (shardcheck.py): symbolic sharding propagation over a
+    traced jaxpr + darray placements — implicit materialization, Partial
+    misuse, donation misses, rank-divergent collectives, pipeline stage
+    boundary misfits.  The VSC12x decline codes are shared with the
+    multi-hop redistribution planner (``redistribute_plan``).
+  * **vescale-lint** (lint.py): AST enforcement of framework invariants —
+    env reads via the central registry (envreg.py), identity-assertable
+    no-op hooks, async-signal-safe handlers, KeyboardInterrupt-safe retry
+    loops.
+
+Mode: ``VESCALE_SHARDCHECK`` = ``off`` | ``warn`` (default) | ``strict``.
+``warn`` surfaces error-severity findings as Python warnings at the
+integration points (dmodule plan validation, the telemetry step report);
+``strict`` raises ``ShardcheckError``.  CLI: ``python -m vescale_tpu.analysis``.
+"""
+
+from __future__ import annotations
+
+import warnings as _warnings
+
+from . import envreg
+from .findings import CODES, Finding, FindingCode, FindingReport, Severity, code
+from .lint import lint_paths, lint_source, rank_divergence_findings
+from .shardcheck import (
+    SymSharding,
+    check_param_plan,
+    check_stage_boundaries,
+    check_transition,
+    shardcheck,
+    shardcheck_jaxpr,
+    sym_from_spec,
+)
+
+__all__ = [
+    "CODES",
+    "Finding",
+    "FindingCode",
+    "FindingReport",
+    "Severity",
+    "code",
+    "envreg",
+    "lint_paths",
+    "lint_source",
+    "rank_divergence_findings",
+    "SymSharding",
+    "sym_from_spec",
+    "shardcheck",
+    "shardcheck_jaxpr",
+    "check_transition",
+    "check_stage_boundaries",
+    "check_param_plan",
+    "mode",
+    "enabled",
+    "is_strict",
+    "ShardcheckError",
+    "dispatch_report",
+]
+
+
+class ShardcheckError(RuntimeError):
+    """Raised in strict mode when a report carries error-severity findings."""
+
+    def __init__(self, report: FindingReport):
+        self.report = report
+        super().__init__(report.format())
+
+
+def mode() -> str:
+    """The active analysis mode: ``off`` | ``warn`` | ``strict``
+    (``VESCALE_SHARDCHECK``; unknown values read as ``warn``)."""
+    m = (envreg.get_str("VESCALE_SHARDCHECK") or "warn").strip().lower()
+    return m if m in ("off", "warn", "strict") else "warn"
+
+
+def enabled() -> bool:
+    return mode() != "off"
+
+
+def is_strict() -> bool:
+    return mode() == "strict"
+
+
+def dispatch_report(report: FindingReport, stacklevel: int = 2) -> FindingReport:
+    """Route a report per the active mode: strict raises ShardcheckError on
+    any error-severity finding, warn emits ONE aggregated warning, off (or
+    a clean report) is silent.  Returns the report for chaining."""
+    if not enabled() or not report.findings:
+        return report
+    if report.count(Severity.ERROR) and is_strict():
+        raise ShardcheckError(report)
+    if report.count(Severity.WARNING):
+        _warnings.warn(
+            "shardcheck: " + report.format(), stacklevel=stacklevel + 1
+        )
+    return report

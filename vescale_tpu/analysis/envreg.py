@@ -1,0 +1,298 @@
+"""Central registry of every ``VESCALE_*`` environment variable.
+
+PRs 1-5 grew ~30 env knobs by convention — each module parsed its own
+``os.environ`` with its own truthiness rules, and nothing said which vars
+exist, what type they are, or what they default to.  This module is the
+single source of truth: every var is declared once (name, type, default,
+one-line doc), reads go through the typed accessors here, and
+``vescale-lint`` (analysis/lint.py, code VSC201) rejects any direct
+``os.environ`` read of a ``VESCALE_*`` name elsewhere in the repo.
+``docs/configuration.md`` is GENERATED from this table
+(``markdown_table()``); a test asserts the doc and the registry agree and
+that no ``VESCALE_*`` string in the package is unregistered (VSC202).
+
+Semantics:
+
+  * Reads are LIVE — accessors hit ``os.environ`` at call time, never a
+    cached snapshot, so tests monkeypatching env vars and runs flipping a
+    knob between phases keep working.
+  * ``bool`` parsing is uniform: unset -> default; "", "0", "false",
+    "off", "no" (case-insensitive) -> False; anything else -> True.
+  * ``default=None`` means "unset": typed accessors return None and the
+    caller owns the fallback (documented in the var's doc line).
+
+This module imports only the stdlib on purpose: it must be importable from
+``__graft_entry__`` bootstrap code and signal-adjacent paths before jax is.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "EnvVar",
+    "register",
+    "lookup",
+    "is_registered",
+    "all_vars",
+    "get_raw",
+    "get_bool",
+    "get_int",
+    "get_float",
+    "get_str",
+    "markdown_table",
+]
+
+_FALSE = ("", "0", "false", "off", "no")
+
+
+def coerce_bool(raw: Optional[str], default: bool) -> bool:
+    """The registry's uniform bool parse applied to a raw string — for
+    tri-state knobs whose UNSET default is computed by the caller (e.g.
+    platform-dependent)."""
+    if raw is None:
+        return default
+    return raw.strip().lower() not in _FALSE
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    """One registered knob: declaration only — the value lives in the
+    process environment and is re-read on every access."""
+
+    name: str
+    type: str  # "bool" | "int" | "float" | "str"
+    default: Any
+    doc: str
+
+    def __post_init__(self):
+        if not self.name.startswith("VESCALE_"):
+            raise ValueError(f"env registry is for VESCALE_* vars, got {self.name!r}")
+        if self.type not in ("bool", "int", "float", "str"):
+            raise ValueError(f"{self.name}: unsupported type {self.type!r}")
+
+
+_REGISTRY: Dict[str, EnvVar] = {}
+
+
+def register(name: str, type: str, default: Any, doc: str) -> EnvVar:
+    """Declare a var.  Idempotent for identical declarations; a conflicting
+    re-declaration raises — two modules must not disagree about a knob."""
+    var = EnvVar(name, type, default, doc)
+    prev = _REGISTRY.get(name)
+    if prev is not None and prev != var:
+        raise ValueError(
+            f"conflicting registration for {name}: {prev} vs {var}"
+        )
+    _REGISTRY[name] = var
+    return var
+
+
+def lookup(name: str) -> EnvVar:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"{name} is not registered in vescale_tpu.analysis.envreg — "
+            "declare it there (name/type/default/doc) before reading it"
+        ) from None
+
+
+def is_registered(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def all_vars() -> List[EnvVar]:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+# ------------------------------------------------------------- accessors
+def get_raw(name: str) -> Optional[str]:
+    """The raw env string, or None when unset.  Registration enforced."""
+    lookup(name)
+    return os.environ.get(name)
+
+
+def get_bool(name: str) -> bool:
+    var = lookup(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return bool(var.default)
+    return raw.strip().lower() not in _FALSE
+
+
+def get_int(name: str) -> Optional[int]:
+    """Unset/empty -> the declared default (None when the default is None);
+    a malformed value raises LOUDLY — silently falling back would disable
+    the very feature the operator tried to configure (a watchdog deadline
+    of "5s" must fail at startup, not quietly never arm)."""
+    var = lookup(name)
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return None if var.default is None else int(var.default)
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r}: expected an int (see docs/configuration.md)"
+        ) from None
+
+
+def get_float(name: str) -> Optional[float]:
+    """Same contract as :func:`get_int` (loud on malformed values)."""
+    var = lookup(name)
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return None if var.default is None else float(var.default)
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r}: expected a float (see docs/configuration.md)"
+        ) from None
+
+
+def get_str(name: str) -> Optional[str]:
+    var = lookup(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return var.default
+    return raw
+
+
+# ------------------------------------------------------------ doc output
+def markdown_table() -> str:
+    """The docs/configuration.md variable table (generated, not hand-kept).
+    A test asserts the committed doc matches this output byte-for-byte."""
+    lines = [
+        "| Variable | Type | Default | Effect |",
+        "| --- | --- | --- | --- |",
+    ]
+    for v in all_vars():
+        default = "unset" if v.default is None else repr(v.default).strip("'\"") or '""'
+        lines.append(f"| `{v.name}` | {v.type} | `{default}` | {v.doc} |")
+    return "\n".join(lines)
+
+
+def configuration_markdown() -> str:
+    """The full docs/configuration.md document (header + generated table).
+    ``python -m vescale_tpu.analysis envdoc --write docs/configuration.md``
+    regenerates it; tests/test_analysis.py asserts the committed file
+    matches this output exactly."""
+    head = (
+        "# Configuration — `VESCALE_*` environment variables\n"
+        "\n"
+        "<!-- GENERATED FILE — do not edit by hand.\n"
+        "     Regenerate: python -m vescale_tpu.analysis envdoc --write docs/configuration.md\n"
+        "     Source of truth: vescale_tpu/analysis/envreg.py -->\n"
+        "\n"
+        "Every knob is declared in `vescale_tpu.analysis.envreg` (name, type,\n"
+        "default, effect) and read through its typed accessors; `vescale-lint`\n"
+        "rejects direct `os.environ` reads of `VESCALE_*` names (VSC201) and\n"
+        "unregistered names (VSC202), so this table is complete by\n"
+        "construction.  Reads are live: flipping a variable between phases\n"
+        "(or monkeypatching it in a test) takes effect on the next read.\n"
+        "Booleans: unset uses the default; `\"\"`, `0`, `false`, `off`, `no`\n"
+        "(case-insensitive) are false; anything else is true.\n"
+        "\n"
+    )
+    return head + markdown_table() + "\n"
+
+
+# =====================================================================
+# Registrations — the full knob surface of the framework, one block per
+# subsystem.  Keep doc lines to one sentence; they become the Effect
+# column of docs/configuration.md verbatim.
+# =====================================================================
+
+# --- analysis --------------------------------------------------------
+register("VESCALE_SHARDCHECK", "str", "warn",
+         "Static-analysis mode: `off` disables, `warn` emits warnings, `strict` raises on error-severity findings (docs/observability.md).")
+
+# --- redistribution --------------------------------------------------
+register("VESCALE_REDISTRIBUTE_MEM_FACTOR", "float", 4.0,
+         "Per-shard memory budget for multi-hop plan intermediates, as a multiple of the larger endpoint shard.")
+register("VESCALE_REDISTRIBUTE_MAX_HOPS", "int", 3,
+         "Hop bound for the multi-hop redistribution planner's lattice search.")
+register("VESCALE_STRICT_REDISTRIBUTE", "bool", False,
+         "Raise instead of warn when redistribute() would take the logical-materializing pack/unpack fallback.")
+
+# --- distributed bootstrap -------------------------------------------
+register("VESCALE_COORDINATOR", "str", None,
+         "Coordinator address (host:port) for jax.distributed.initialize; unset on TPU pods (auto-detected).")
+register("VESCALE_NUM_PROCESSES", "int", None,
+         "World size for multi-process initialization; unset = auto-detect.")
+register("VESCALE_PROCESS_ID", "int", None,
+         "This process's rank for multi-process initialization and the faultsim `rank=` selector; unset = auto-detect.")
+register("VESCALE_BARRIER_TIMEOUT", "float", None,
+         "Deadline in seconds for barrier/all_processes_ok (BarrierTimeout past it); unset or <=0 disables.")
+register("VESCALE_CONSISTENCY_EVERY", "int", None,
+         "Cross-rank state-fingerprint cadence in steps for run_resilient; unset = 32 (armed only when coordinating).")
+
+# --- debug -----------------------------------------------------------
+register("VESCALE_DEBUG_MODE", "str", "",
+         "DebugLogger gate: `1` logs on every rank, `rank0,1` restricts to listed ranks, empty/0 disables.")
+
+# --- checkpoint / IO retry -------------------------------------------
+register("VESCALE_NATIVE_CKPT_IO", "bool", True,
+         "Use the native (nogil) checkpoint write pool; `0` forces the Python thread pool (required for storage fault injection).")
+register("VESCALE_CKPT_RETRIES", "int", 3,
+         "Max attempts for checkpoint storage read/write under the retry policy.")
+register("VESCALE_LOADER_RETRIES", "int", 3,
+         "Max attempts for a data-loader batch fetch under the retry policy.")
+register("VESCALE_IO_BACKOFF_BASE", "float", 0.05,
+         "First retry backoff sleep in seconds (exponential from here).")
+register("VESCALE_IO_BACKOFF_MAX", "float", 5.0,
+         "Retry backoff ceiling in seconds.")
+register("VESCALE_IO_BACKOFF_JITTER", "float", 0.25,
+         "Seeded jitter fraction applied to each backoff sleep.")
+register("VESCALE_IO_ATTEMPT_TIMEOUT", "float", 0.0,
+         "Per-attempt timeout in seconds for retried IO (helper thread); 0 disables.")
+
+# --- resilience ------------------------------------------------------
+register("VESCALE_FAULTSIM", "str", None,
+         'Deterministic fault-injection schedule, e.g. `storage_write:call=3;preempt:step=10` (resilience/faultsim.py grammar).')
+register("VESCALE_FAULTSIM_HANG_S", "float", 3600.0,
+         "Stall duration in seconds for the faultsim `hang` kind (watchdog test fodder).")
+register("VESCALE_WATCHDOG_TIMEOUT", "float", 0.0,
+         "Hang-watchdog step-progress deadline in seconds; unset or <=0 disables the watchdog.")
+register("VESCALE_WATCHDOG_ABORT", "bool", True,
+         "On a detected hang, os._exit after the stack dump so a supervisor can restart (disable to only dump).")
+register("VESCALE_WATCHDOG_EXIT_CODE", "int", 17,
+         "Process exit code used by the watchdog abort path.")
+register("VESCALE_WATCHDOG_DIR", "str", None,
+         "Directory for watchdog hang dumps when telemetry has no out_dir; unset disables dumping.")
+
+# --- bench harness ---------------------------------------------------
+register("VESCALE_BENCH", "str", None,
+         "Which bench rung to run (e.g. `serve`, `redistribute`, `memtrack`, `watchdog`); unset = default MFU line.")
+register("VESCALE_BENCH_RUNG", "str", "1.3b",
+         "Model size rung for the 1B-sweep bench script.")
+register("VESCALE_BENCH_STEP_REPORT", "bool", None,
+         "Write a compile-time step report during bench runs; unset = on for CPU, off on TPU.")
+register("VESCALE_BENCH_NO_REGISTER", "bool", False,
+         "Skip BENCH_r*.json registration (set for child/sub-bench processes).")
+register("VESCALE_BENCH_BUDGET_S", "float", 1200.0,
+         "Wall-clock budget in seconds for the bench driver.")
+register("VESCALE_BENCH_CHILD", "bool", False,
+         "Marks a bench subprocess (internal; set by the bench driver).")
+
+# --- AOT report scripts ----------------------------------------------
+register("VESCALE_AOT_MODEL", "str", "8b",
+         "Model config for scripts/aot_8b_report.py (`8b`, `70b`, `405b`, `mixtral`).")
+register("VESCALE_AOT_FP8", "bool", False,
+         "AOT-report the fp8 variant.")
+register("VESCALE_AOT_ZB", "bool", False,
+         "AOT-report the zero-bubble schedule variant.")
+register("VESCALE_AOT_CHILD", "bool", False,
+         "Marks an AOT-report subprocess (internal; set by the driver).")
+register("VESCALE_AOT_DEBUG", "bool", False,
+         "Verbose AOT-report debugging output.")
+
+# --- entry / misc ----------------------------------------------------
+register("VESCALE_DRYRUN_VIRTUAL_CHILD", "bool", False,
+         "Marks a virtual-device dry-run subprocess (internal; set by __graft_entry__).")
+register("VESCALE_FP8_ON_TPU", "bool", False,
+         "Allow the fp8 example on real TPU backends (off = CPU emulation only).")

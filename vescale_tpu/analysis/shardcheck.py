@@ -1,0 +1,1027 @@
+"""shardcheck — trace-time SPMD/placement analysis over a jaxpr.
+
+The premise (Mesh-TensorFlow arXiv:1811.02084, "On Optimizing the
+Communication of Model Parallelism" arXiv:2211.05322): a layout is a static
+object over the program graph, so layout *mistakes* are statically
+decidable.  GSPMD will silently repair a bad layout at runtime — by
+all-gathering a sharded operand into every device (the materialization this
+framework exists to avoid) or by inserting resharding collectives — and the
+first sign is an OOM or a 4x step time at step 10k.  shardcheck walks the
+traced jaxpr (``jax.make_jaxpr`` — the same trace the AOT lowering path
+takes) with a symbolic sharding per variable and emits coded findings
+(analysis/findings.py) *before* anything runs:
+
+  VSC101  an op forces implicit full materialization of a sharded operand
+          (reshape merging a sharded dim under an outer factor, concatenate
+          along a sharded dim, gather/sort along a sharded dim)
+  VSC102  sharding conflict between operands forces a reshard
+  VSC103  Partial placement consumed by a non-linear op (silently wrong
+          numerics under veScale semantics)
+  VSC105  donation miss: a step input rebuilt as an output but not donated
+          (double-buffers params/optimizer state)
+
+plus the source-level VSC104 (collectives under rank-divergent Python
+control flow — shared with vescale-lint) when the checked callable's source
+is retrievable.
+
+Byte/cost estimates price the implied movement with the SAME per-collective
+cost functions auto-plan uses (``collectives.allgather_cost`` et al.), so a
+finding's cost column and the planner's objective agree by construction.
+
+The propagation is deliberately conservative: unknown primitives propagate
+"replicated, no finding" — shardcheck under-reports rather than cry wolf.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .findings import CODES, Finding, FindingReport, Severity
+
+__all__ = [
+    "SymSharding",
+    "shardcheck",
+    "shardcheck_jaxpr",
+    "sym_from_spec",
+    "check_transition",
+    "check_stage_boundaries",
+    "check_param_plan",
+]
+
+
+# ---------------------------------------------------------------- symbolic
+@dataclasses.dataclass(frozen=True)
+class SymSharding:
+    """Symbolic sharding of one intermediate: per tensor dim, the mesh axis
+    names sharding it; plus pending-reduction (Partial) axes with their
+    reduce op.  The trace-time mirror of a ``DArraySpec``.
+
+    ``partial`` holds DECLARED partials — a veScale ``Partial`` placement on
+    an input spec, where the program itself owns the reduction; consuming
+    one non-linearly is the VSC103 bug.  ``auto_partial`` holds partials the
+    program DERIVES (a dot_general contracting a sharded dim, a reduce over
+    a sharded dim): inside a jit program GSPMD inserts the all-reduce at the
+    point of use — correct numerics, the expected TP boundary collective —
+    so these propagate silently and are cleared at consumption."""
+
+    axes: Tuple[Tuple[str, ...], ...]
+    partial: Tuple[Tuple[str, str], ...] = ()  # declared (mesh_axis, reduce_op)
+    auto_partial: Tuple[Tuple[str, str], ...] = ()  # derived; GSPMD-resolved
+
+    @staticmethod
+    def replicated(ndim: int) -> "SymSharding":
+        return SymSharding(tuple(() for _ in range(ndim)))
+
+    @property
+    def ndim(self) -> int:
+        return len(self.axes)
+
+    def is_sharded(self) -> bool:
+        return any(self.axes) or bool(self.partial) or bool(self.auto_partial)
+
+    def sharded_axes(self) -> Tuple[str, ...]:
+        out: List[str] = []
+        for dims in self.axes:
+            out.extend(dims)
+        return tuple(out)
+
+    def partial_axes(self) -> Tuple[str, ...]:
+        return tuple(a for a, _ in self.partial)
+
+    def drop_partial(self) -> "SymSharding":
+        return SymSharding(self.axes, (), ())
+
+    def __str__(self) -> str:
+        dims = ",".join("+".join(a) if a else "-" for a in self.axes)
+        p = "".join(f" partial({a}:{op})" for a, op in self.partial)
+        p += "".join(f" auto({a}:{op})" for a, op in self.auto_partial)
+        return f"[{dims}]{p}"
+
+
+def sym_from_spec(spec, ndim: Optional[int] = None) -> SymSharding:
+    """SymSharding from a DArraySpec / placements+mesh / PartitionSpec.
+
+    Accepts a ``DArraySpec`` (uses LOGICAL dims: interleave and ragged
+    approximate to their leading dim), a ``jax.sharding.NamedSharding``, or
+    a bare ``PartitionSpec`` (with ``ndim``)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if isinstance(spec, PartitionSpec):
+        return _sym_from_pspec(spec, ndim if ndim is not None else len(spec))
+    if isinstance(spec, NamedSharding):
+        return _sym_from_pspec(spec.spec, ndim if ndim is not None else len(spec.spec))
+    # DArraySpec
+    from ..placements import InterleavedShard, RaggedShard, Shard
+
+    axes: List[List[str]] = [[] for _ in range(spec.ndim)]
+    partial: List[Tuple[str, str]] = []
+    for i, p in enumerate(spec.placements):
+        name = spec.mesh.dim_name(i)
+        if type(p) is Shard or isinstance(p, InterleavedShard):
+            axes[p.dim].append(name)
+        elif isinstance(p, RaggedShard):
+            axes[p.dims[0]].append(name)
+        elif p.is_partial():
+            partial.append((name, p.reduce_op))
+    return SymSharding(tuple(tuple(a) for a in axes), tuple(partial))
+
+
+def _sym_from_pspec(pspec, ndim: int) -> SymSharding:
+    axes: List[Tuple[str, ...]] = []
+    entries = list(pspec) + [None] * (ndim - len(pspec))
+    for e in entries[:ndim]:
+        if e is None:
+            axes.append(())
+        elif isinstance(e, (tuple, list)):
+            axes.append(tuple(str(n) for n in e))
+        else:
+            axes.append((str(e),))
+    return SymSharding(tuple(axes))
+
+
+# ------------------------------------------------------------- primitives
+# elementwise-linear in each operand: Partial flows through
+_LINEAR_ELTWISE = {
+    "add", "sub", "neg", "convert_element_type", "copy", "real", "imag",
+    "reduce_precision", "stop_gradient", "cumsum",
+}
+# nonlinear / order-sensitive elementwise: Partial consumed here is wrong
+_NONLINEAR_ELTWISE = {
+    "exp", "log", "log1p", "expm1", "tanh", "sin", "cos", "tan", "logistic",
+    "erf", "erfc", "erf_inv", "rsqrt", "sqrt", "cbrt", "square", "sign",
+    "floor", "ceil", "round", "integer_pow", "pow", "abs", "is_finite",
+    "max", "min", "rem", "atan2", "nextafter", "clamp", "and", "or", "xor",
+    "not", "eq", "ne", "lt", "le", "gt", "ge", "select_n", "cummax",
+    "cummin", "cumprod", "erf_inv", "digamma", "lgamma",
+}
+_PASSTHROUGH_PARTIAL = {"transpose", "reshape", "broadcast_in_dim", "squeeze",
+                        "slice", "expand_dims", "rev", "pad"}
+_INNER_JAXPR_PRIMS = {
+    "pjit": "jaxpr",
+    "closed_call": "call_jaxpr",
+    "core_call": "call_jaxpr",
+    "remat": "jaxpr",
+    "remat2": "jaxpr",
+    "checkpoint": "jaxpr",
+    "custom_jvp_call": "call_jaxpr",
+    "custom_vjp_call": "call_jaxpr",
+    "custom_jvp_call_jaxpr": "fun_jaxpr",
+    "custom_vjp_call_jaxpr": "fun_jaxpr",
+}
+
+
+def _axis_prod(axis_sizes: Dict[str, int], names) -> int:
+    out = 1
+    for n in names:
+        out *= int(axis_sizes.get(n, 1))
+    return out
+
+
+def _full_bytes(aval) -> int:
+    try:
+        return int(np.prod(aval.shape, dtype=np.int64)) * np.dtype(aval.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def _gather_cost_us(full_bytes: int, axis_sizes: Dict[str, int], axes) -> float:
+    """Price an all-gather of a (currently sharded) operand back to full,
+    using collectives.py's cost model — the analysis and auto-plan read the
+    same objective."""
+    from ..collectives import allgather_cost
+
+    cost = 0.0
+    for a in axes:
+        cost += allgather_cost(full_bytes / 1e9, int(axis_sizes.get(a, 1)))
+    return cost
+
+
+def _src(eqn) -> str:
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is not None:
+            return f"{eqn.primitive.name} @ {frame.file_name}:{frame.start_line}"
+    except Exception:
+        pass
+    return eqn.primitive.name
+
+
+class _Checker:
+    def __init__(self, axis_sizes: Dict[str, int], report: FindingReport,
+                 min_bytes: int):
+        self.axis_sizes = dict(axis_sizes)
+        self.report = report
+        self.min_bytes = int(min_bytes)
+        self._flagged: set = set()  # dedup (code, where) pairs
+
+    # ------------------------------------------------------------- helpers
+    def _emit(self, code: str, message: str, eqn=None, *, mesh_dim=None,
+              bytes_est=None, cost_us=None, severity=None) -> None:
+        where = _src(eqn) if eqn is not None else None
+        key = (code, where, message)
+        if key in self._flagged:
+            return
+        self._flagged.add(key)
+        self.report.add(Finding(CODES[code], message, where=where,
+                                mesh_dim=mesh_dim, bytes_est=bytes_est,
+                                cost_us=cost_us, severity=severity))
+
+    def _materialize(self, eqn, aval, sym: SymSharding, why: str,
+                     severity: Optional[Severity] = None) -> None:
+        full = _full_bytes(aval)
+        if full < self.min_bytes:
+            return
+        axes = sym.sharded_axes()
+        n = _axis_prod(self.axis_sizes, axes)
+        if n <= 1:
+            return
+        self._emit(
+            "VSC101",
+            f"{why}: a {tuple(aval.shape)} {np.dtype(aval.dtype).name} operand "
+            f"sharded {n}-way over {list(axes)} must be gathered to full size "
+            "on every device",
+            eqn,
+            mesh_dim=axes[0] if axes else None,
+            bytes_est=full,
+            cost_us=_gather_cost_us(full, self.axis_sizes, axes),
+            severity=severity,
+        )
+
+    def _partial_misuse(self, eqn, sym: SymSharding, why: str) -> None:
+        axes = sym.partial_axes()
+        self._emit(
+            "VSC103",
+            f"{why}: the operand is Partial({','.join(axes)}) — pending "
+            "reduction; applying a non-linear op before reducing computes "
+            "f(x_i) per replica instead of f(sum_i x_i)",
+            eqn,
+            mesh_dim=axes[0] if axes else None,
+        )
+
+    # ------------------------------------------------------------ the walk
+    def run(self, closed_jaxpr, in_syms: Sequence[SymSharding]) -> List[SymSharding]:
+        jaxpr = closed_jaxpr.jaxpr
+        env: Dict[Any, SymSharding] = {}
+
+        def write(var, sym: SymSharding) -> None:
+            env[var] = sym
+
+        def read(atom) -> SymSharding:
+            if hasattr(atom, "val"):  # Literal
+                ndim = getattr(np.asarray(atom.val), "ndim", 0)
+                return SymSharding.replicated(ndim)
+            return env.get(atom, SymSharding.replicated(len(getattr(atom.aval, "shape", ()))))
+
+        for var in jaxpr.constvars:
+            write(var, SymSharding.replicated(len(getattr(var.aval, "shape", ()))))
+        for var, sym in zip(jaxpr.invars, in_syms):
+            write(var, sym)
+        for extra in jaxpr.invars[len(in_syms):]:
+            write(extra, SymSharding.replicated(len(getattr(extra.aval, "shape", ()))))
+
+        for eqn in jaxpr.eqns:
+            outs = self._eqn(eqn, [read(v) for v in eqn.invars])
+            for var, sym in zip(eqn.outvars, outs):
+                nd = len(getattr(var.aval, "shape", ()))
+                if sym.ndim != nd:  # defensive: never poison the env
+                    sym = SymSharding.replicated(nd)
+                write(var, sym)
+        return [read(v) for v in jaxpr.outvars]
+
+    def _sub(self, closed, in_syms) -> List[SymSharding]:
+        try:
+            return self.run(closed, in_syms)
+        except Exception:
+            return [
+                SymSharding.replicated(len(getattr(v.aval, "shape", ())))
+                for v in closed.jaxpr.outvars
+            ]
+
+    # ------------------------------------------------------- per-primitive
+    def _eqn(self, eqn, ins: List[SymSharding]) -> List[SymSharding]:
+        name = eqn.primitive.name
+        out_avals = [v.aval for v in eqn.outvars]
+
+        try:
+            if name in _INNER_JAXPR_PRIMS:
+                closed = eqn.params.get(_INNER_JAXPR_PRIMS[name])
+                if closed is None:
+                    return self._default(eqn, ins)
+                return self._sub(closed, ins)
+            if name == "scan":
+                return self._scan(eqn, ins)
+            if name == "while":
+                return self._while(eqn, ins)
+            if name == "cond":
+                return self._cond(eqn, ins)
+            if name in ("sharding_constraint", "device_put"):
+                return self._constraint(eqn, ins)
+            if name == "dot_general":
+                return [self._dot_general(eqn, ins)]
+            if name == "reshape":
+                return [self._reshape(eqn, ins[0])]
+            if name == "transpose":
+                perm = eqn.params["permutation"]
+                return [SymSharding(tuple(ins[0].axes[p] for p in perm),
+                                    ins[0].partial, ins[0].auto_partial)]
+            if name == "broadcast_in_dim":
+                return [self._broadcast(eqn, ins[0])]
+            if name == "squeeze":
+                dims = set(eqn.params["dimensions"])
+                axes = tuple(a for d, a in enumerate(ins[0].axes) if d not in dims)
+                return [SymSharding(axes, ins[0].partial, ins[0].auto_partial)]
+            if name == "expand_dims":
+                dims = set(eqn.params["dimensions"])
+                nd = len(out_avals[0].shape)
+                it = iter(ins[0].axes)
+                axes = tuple(() if d in dims else next(it) for d in range(nd))
+                return [SymSharding(axes, ins[0].partial, ins[0].auto_partial)]
+            if name == "concatenate":
+                return [self._concatenate(eqn, ins)]
+            if name.startswith("reduce_") or name in ("argmax", "argmin"):
+                return [self._reduce(eqn, ins, name)]
+            if name in ("sort", "top_k"):
+                return self._sort(eqn, ins, out_avals)
+            if name == "gather":
+                return [self._gather(eqn, ins)]
+            if name in ("slice", "dynamic_slice", "dynamic_update_slice", "pad", "rev"):
+                return [self._slicelike(eqn, ins, out_avals[0])]
+            if name == "iota":
+                return [SymSharding.replicated(len(out_avals[0].shape))]
+            return self._default(eqn, ins)
+        except Exception:
+            return [
+                SymSharding.replicated(len(getattr(a, "shape", ())))
+                for a in out_avals
+            ]
+
+    # --- generic elementwise --------------------------------------------
+    def _default(self, eqn, ins: List[SymSharding]) -> List[SymSharding]:
+        name = eqn.primitive.name
+        out_avals = [v.aval for v in eqn.outvars]
+        nd = len(getattr(out_avals[0], "shape", ()))
+
+        arrayish = [s for s in ins if s.ndim == nd]
+        partial_ins = [s for s in ins if s.partial]
+
+        known_eltwise = (
+            name in _LINEAR_ELTWISE or name in _NONLINEAR_ELTWISE or name in ("mul", "div")
+        )
+        if partial_ins and known_eltwise:
+            if name in _NONLINEAR_ELTWISE:
+                self._partial_misuse(eqn, partial_ins[0], f"non-linear op `{name}`")
+            elif name in ("add", "sub"):
+                # additive mix of Partial and non-Partial inflates the sum
+                # n-fold; Partial+Partial on the same axes is fine (linear)
+                psets = {s.partial for s in ins if s.ndim > 0 or s.partial}
+                if any(not s.partial for s in ins) or len({s.partial for s in partial_ins}) > 1:
+                    if any(not s.partial and (s.ndim == nd) for s in ins):
+                        self._partial_misuse(
+                            eqn, partial_ins[0],
+                            f"additive op `{name}` mixing Partial and non-Partial operands",
+                        )
+            elif name in ("mul", "div") and len(partial_ins) > 1:
+                self._partial_misuse(eqn, partial_ins[0], f"product of two Partial operands in `{name}`")
+            elif name == "div" and ins[-1].partial:
+                self._partial_misuse(eqn, ins[-1], "Partial operand as divisor")
+
+        if not arrayish:
+            return [SymSharding.replicated(len(getattr(a, "shape", ()))) for a in out_avals]
+
+        if not known_eltwise and name not in ("select_n",):
+            # unknown primitive: stay silent and conservative
+            return [SymSharding.replicated(len(getattr(a, "shape", ()))) for a in out_avals]
+
+        # merge aligned dims; conflicting non-empty axis sets => reshard
+        axes: List[Tuple[str, ...]] = []
+        for d in range(nd):
+            cands = [s.axes[d] for s in arrayish if s.axes[d]]
+            uniq = {c for c in cands}
+            if len(uniq) > 1:
+                shapes = tuple(getattr(out_avals[0], "shape", ()))
+                n0 = _axis_prod(self.axis_sizes, next(iter(uniq)))
+                full = _full_bytes(out_avals[0])
+                if full >= self.min_bytes:
+                    self._emit(
+                        "VSC102",
+                        f"operands of `{name}` disagree on dim {d} sharding "
+                        f"({sorted(','.join(u) for u in uniq)}); the partitioner "
+                        "must reshard one operand",
+                        eqn,
+                        bytes_est=full // max(1, n0),
+                    )
+            axes.append(cands[0] if cands else ())
+        partial = partial_ins[0].partial if partial_ins else ()
+        # derived partials: nonlinear consumption is where GSPMD inserts the
+        # implicit all-reduce — the value is fully reduced downstream
+        auto: Dict[str, str] = {}
+        if name not in _NONLINEAR_ELTWISE:
+            for s in arrayish:
+                for a, op in s.auto_partial:
+                    auto.setdefault(a, op)
+        out = SymSharding(tuple(axes), partial, tuple(sorted(auto.items())))
+        return [out if len(getattr(a, "shape", ())) == nd
+                else SymSharding.replicated(len(getattr(a, "shape", ())))
+                for a in out_avals]
+
+    # --- structured ops ---------------------------------------------------
+    def _dot_general(self, eqn, ins: List[SymSharding]) -> SymSharding:
+        lhs, rhs = ins[0], ins[1]
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        if lhs.partial and rhs.partial:
+            self._partial_misuse(eqn, lhs, "dot_general of two Partial operands")
+        partial: List[Tuple[str, str]] = list(lhs.partial) + list(rhs.partial)
+        # a contraction over a sharded dim yields a DERIVED partial: GSPMD
+        # all-reduces it at the point of use (the expected TP collective)
+        auto: List[Tuple[str, str]] = list(lhs.auto_partial) + list(rhs.auto_partial)
+        for dl, dr in zip(lc, rc):
+            for a in set(lhs.axes[dl]) | set(rhs.axes[dr]):
+                auto.append((a, "sum"))
+        lhs_free = [d for d in range(lhs.ndim) if d not in lc and d not in lb]
+        rhs_free = [d for d in range(rhs.ndim) if d not in rc and d not in rb]
+        axes: List[Tuple[str, ...]] = []
+        for dl, dr in zip(lb, rb):
+            both = lhs.axes[dl] or rhs.axes[dr]
+            if lhs.axes[dl] and rhs.axes[dr] and lhs.axes[dl] != rhs.axes[dr]:
+                self._emit(
+                    "VSC102",
+                    f"batch dims of dot_general sharded differently "
+                    f"({list(lhs.axes[dl])} vs {list(rhs.axes[dr])})",
+                    eqn,
+                )
+            axes.append(both)
+        axes.extend(lhs.axes[d] for d in lhs_free)
+        axes.extend(rhs.axes[d] for d in rhs_free)
+        # same mesh axis appearing on two output dims (or output + partial):
+        # the partitioner must reshard one usage
+        seen: Dict[str, int] = {}
+        for dims in axes:
+            for a in dims:
+                seen[a] = seen.get(a, 0) + 1
+        for a, _op in partial + auto:
+            seen[a] = seen.get(a, 0) + 1
+        dup = [a for a, k in seen.items() if k > 1]
+        if dup:
+            self._emit(
+                "VSC102",
+                f"mesh axis {dup[0]!r} used by multiple dot_general operands "
+                "in conflicting roles; a reshard will be inserted",
+                eqn,
+                mesh_dim=dup[0],
+            )
+            axes = [tuple(a for a in dims if a not in dup) for dims in axes]
+            partial = [(a, op) for a, op in partial if a not in dup]
+            auto = [(a, op) for a, op in auto if a not in dup]
+        pdict: Dict[str, str] = {}
+        for a, op in partial:
+            pdict.setdefault(a, op)
+        adict: Dict[str, str] = {}
+        for a, op in auto:
+            if a not in pdict:
+                adict.setdefault(a, op)
+        return SymSharding(tuple(axes), tuple(sorted(pdict.items())),
+                           tuple(sorted(adict.items())))
+
+    def _reshape(self, eqn, x: SymSharding) -> SymSharding:
+        aval_in = eqn.invars[0].aval
+        aval_out = eqn.outvars[0].aval
+        if eqn.params.get("dimensions") is not None:
+            if x.is_sharded():
+                self._materialize(eqn, aval_in, x, "permuting reshape of a sharded operand",
+                                  severity=Severity.WARNING)
+            return SymSharding.replicated(len(aval_out.shape))
+        in_shape = tuple(aval_in.shape)
+        out_shape = tuple(aval_out.shape)
+        groups = _reshape_groups(in_shape, out_shape)
+        axes: List[Tuple[str, ...]] = [() for _ in out_shape]
+        for in_dims, out_dims in groups:
+            for pos, d in enumerate(in_dims):
+                if not x.axes[d]:
+                    continue
+                n = _axis_prod(self.axis_sizes, x.axes[d])
+                outer_extent = int(np.prod([in_shape[q] for q in in_dims[:pos]], dtype=np.int64)) if pos else 1
+                lead_out = out_dims[0]
+                if pos == 0 or outer_extent == 1:
+                    # outermost factor of the group: block order is preserved;
+                    # sharding lands on the group's leading output dim
+                    if out_shape[lead_out] % n == 0:
+                        axes[lead_out] = tuple(axes[lead_out]) + x.axes[d]
+                        continue
+                self._materialize(
+                    eqn, aval_in, SymSharding(
+                        tuple(x.axes[q] if q == d else () for q in range(x.ndim))
+                    ),
+                    f"reshape {in_shape} -> {out_shape} merges sharded dim {d} "
+                    "under an outer factor (shard block order not preserved)",
+                )
+        return SymSharding(tuple(axes), x.partial, x.auto_partial)
+
+    def _broadcast(self, eqn, x: SymSharding) -> SymSharding:
+        bd = eqn.params["broadcast_dimensions"]
+        aval_in = eqn.invars[0].aval
+        aval_out = eqn.outvars[0].aval
+        axes: List[Tuple[str, ...]] = [() for _ in aval_out.shape]
+        for i, d in enumerate(bd):
+            if aval_in.shape[i] == aval_out.shape[d]:
+                axes[d] = x.axes[i]
+        return SymSharding(tuple(axes), x.partial, x.auto_partial)
+
+    def _concatenate(self, eqn, ins: List[SymSharding]) -> SymSharding:
+        dim = eqn.params["dimension"]
+        aval_out = eqn.outvars[0].aval
+        for i, s in enumerate(ins):
+            if s.axes[dim]:
+                self._materialize(
+                    eqn, eqn.invars[i].aval,
+                    SymSharding(tuple(s.axes[q] if q == dim else () for q in range(s.ndim))),
+                    f"concatenate along sharded dim {dim}",
+                )
+        axes = []
+        for d in range(len(aval_out.shape)):
+            if d == dim:
+                axes.append(())
+            else:
+                cands = [s.axes[d] for s in ins if s.axes[d]]
+                axes.append(cands[0] if cands else ())
+        return SymSharding(tuple(axes))
+
+    def _reduce(self, eqn, ins: List[SymSharding], name: str) -> SymSharding:
+        x = ins[0]
+        dims = set(eqn.params.get("axes", ()))
+        if x.partial and name in ("reduce_max", "reduce_min", "reduce_prod",
+                                  "argmax", "argmin", "reduce_and", "reduce_or"):
+            self._partial_misuse(eqn, x, f"non-linear reduction `{name}`")
+        reduced_axes: List[str] = []
+        axes: List[Tuple[str, ...]] = []
+        for d in range(x.ndim):
+            if d in dims:
+                reduced_axes.extend(x.axes[d])
+            else:
+                axes.append(x.axes[d])
+        partial = list(x.partial)
+        auto = list(x.auto_partial)
+        op = {"reduce_sum": "sum", "reduce_max": "max", "reduce_min": "min"}.get(name)
+        if reduced_axes:
+            if op is not None:
+                # reducing over a sharded dim derives a partial GSPMD will
+                # all-reduce at the point of use — auto, not declared
+                auto.extend((a, op) for a in reduced_axes)
+            elif name in ("argmax", "argmin"):
+                self._materialize(
+                    eqn, eqn.invars[0].aval,
+                    SymSharding(tuple(x.axes[d] if d in dims else () for d in range(x.ndim))),
+                    f"`{name}` over a sharded dim",
+                    severity=Severity.WARNING,
+                )
+        pdict: Dict[str, str] = {}
+        for a, o in partial:
+            pdict.setdefault(a, o)
+        adict: Dict[str, str] = {}
+        for a, o in auto:
+            if a not in pdict:
+                adict.setdefault(a, o)
+        return SymSharding(tuple(axes), tuple(sorted(pdict.items())),
+                           tuple(sorted(adict.items())))
+
+    def _sort(self, eqn, ins, out_avals) -> List[SymSharding]:
+        dim = eqn.params.get("dimension", len(getattr(out_avals[0], "shape", ())) - 1)
+        for i, s in enumerate(ins):
+            if s.ndim > dim and s.axes[dim]:
+                self._materialize(
+                    eqn, eqn.invars[i].aval,
+                    SymSharding(tuple(s.axes[q] if q == dim else () for q in range(s.ndim))),
+                    f"`{eqn.primitive.name}` along sharded dim {dim}",
+                    severity=Severity.WARNING,
+                )
+        return [SymSharding.replicated(len(getattr(a, "shape", ()))) for a in out_avals]
+
+    def _gather(self, eqn, ins: List[SymSharding]) -> SymSharding:
+        operand = ins[0]
+        aval_out = eqn.outvars[0].aval
+        dnums = eqn.params["dimension_numbers"]
+        slice_sizes = eqn.params.get("slice_sizes", ())
+        operand_aval = eqn.invars[0].aval
+        for d in getattr(dnums, "start_index_map", ()):
+            if d < operand.ndim and operand.axes[d] and (
+                not slice_sizes or slice_sizes[d] != operand_aval.shape[d]
+            ):
+                self._materialize(
+                    eqn, operand_aval,
+                    SymSharding(tuple(operand.axes[q] if q == d else () for q in range(operand.ndim))),
+                    f"gather indexes into sharded dim {d}",
+                    severity=Severity.WARNING,
+                )
+        return SymSharding.replicated(len(aval_out.shape))
+
+    def _slicelike(self, eqn, ins: List[SymSharding], out_aval) -> SymSharding:
+        x = ins[0]
+        nd = len(getattr(out_aval, "shape", ()))
+        if x.ndim != nd:
+            return SymSharding.replicated(nd)
+        in_shape = tuple(eqn.invars[0].aval.shape)
+        out_shape = tuple(out_aval.shape)
+        axes = tuple(
+            x.axes[d] if d < len(in_shape) and in_shape[d] == out_shape[d] else ()
+            for d in range(nd)
+        )
+        return SymSharding(axes, x.partial, x.auto_partial)
+
+    def _constraint(self, eqn, ins: List[SymSharding]) -> List[SymSharding]:
+        shd = eqn.params.get("sharding")
+        if shd is None:
+            shardings = eqn.params.get("devices") or eqn.params.get("shardings")
+            shd = shardings[0] if isinstance(shardings, (tuple, list)) and shardings else None
+        out_nd = len(getattr(eqn.outvars[0].aval, "shape", ()))
+        sym = None
+        try:
+            pspec = getattr(shd, "spec", None)
+            if pspec is not None:
+                sym = _sym_from_pspec(pspec, out_nd)
+        except Exception:
+            sym = None
+        if sym is None:
+            sym = ins[0] if ins and ins[0].ndim == out_nd else SymSharding.replicated(out_nd)
+        return [sym]
+
+    # --- control flow -----------------------------------------------------
+    def _scan(self, eqn, ins: List[SymSharding]) -> List[SymSharding]:
+        closed = eqn.params["jaxpr"]
+        n_consts = eqn.params["num_consts"]
+        n_carry = eqn.params["num_carry"]
+        body_in: List[SymSharding] = []
+        for i, s in enumerate(ins):
+            if i < n_consts + n_carry:
+                body_in.append(s)
+            else:  # xs: leading scan dim stripped
+                body_in.append(
+                    SymSharding(s.axes[1:], s.partial, s.auto_partial) if s.ndim else s
+                )
+        outs = self._sub(closed, body_in)
+        carry_out = outs[:n_carry]
+        ys = [SymSharding(((),) + s.axes, s.partial, s.auto_partial)
+              for s in outs[n_carry:]]
+        return carry_out + ys
+
+    def _while(self, eqn, ins: List[SymSharding]) -> List[SymSharding]:
+        closed = eqn.params["body_jaxpr"]
+        n_cconst = eqn.params["cond_nconsts"]
+        n_bconst = eqn.params["body_nconsts"]
+        carry = ins[n_cconst + n_bconst:]
+        return self._sub(closed, list(ins[n_cconst:n_cconst + n_bconst]) + list(carry))
+
+    def _cond(self, eqn, ins: List[SymSharding]) -> List[SymSharding]:
+        branches = eqn.params["branches"]
+        outs = None
+        for br in branches:
+            o = self._sub(br, ins[1:])
+            outs = o if outs is None else outs
+        return outs if outs is not None else [
+            SymSharding.replicated(len(getattr(v.aval, "shape", ())))
+            for v in eqn.outvars
+        ]
+
+
+def _reshape_groups(in_shape, out_shape):
+    """Balanced factor groups of a reshape: list of (in_dims, out_dims) with
+    equal products (the standard merge/split decomposition)."""
+    i = j = 0
+    groups = []
+    ni, nj = len(in_shape), len(out_shape)
+    while i < ni and j < nj:
+        in_dims, out_dims = [i], [j]
+        pi, pj = in_shape[i], out_shape[j]
+        while pi != pj:
+            if pi < pj:
+                i += 1
+                in_dims.append(i)
+                pi *= in_shape[i]
+            else:
+                j += 1
+                out_dims.append(j)
+                pj *= out_shape[j]
+        groups.append((in_dims, out_dims))
+        i += 1
+        j += 1
+    while i < ni:
+        groups.append(([i], []))
+        i += 1
+    while j < nj:
+        groups.append(([], [j]))
+        j += 1
+    return groups
+
+
+# ------------------------------------------------------------ entry points
+def _leaf_sym(leaf, entry, ndim: int) -> SymSharding:
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if entry is not None:
+        if isinstance(entry, SymSharding):
+            return entry
+        if isinstance(entry, (PartitionSpec, NamedSharding)):
+            return sym_from_spec(entry, ndim)
+        return sym_from_spec(entry, ndim)  # DArraySpec
+    shd = getattr(leaf, "sharding", None)
+    if shd is not None and isinstance(shd, NamedSharding):
+        return sym_from_spec(shd, ndim)
+    return SymSharding.replicated(ndim)
+
+
+def _axis_sizes_from(args, in_specs, mesh) -> Dict[str, int]:
+    import jax
+
+    if mesh is not None:
+        if isinstance(mesh, dict):  # bare axis-size map: no devices needed
+            return {str(k): int(v) for k, v in mesh.items()}
+        jm = getattr(mesh, "jax_mesh", mesh)
+        return dict(zip(jm.axis_names, jm.devices.shape))
+    sizes: Dict[str, int] = {}
+    for leaf in jax.tree_util.tree_leaves(args):
+        shd = getattr(leaf, "sharding", None)
+        jm = getattr(shd, "mesh", None)
+        if jm is not None and hasattr(jm, "axis_names"):
+            try:
+                sizes.update(dict(zip(jm.axis_names, jm.devices.shape)))
+            except Exception:
+                sizes.update(getattr(jm, "shape", {}) or {})
+    for entry in jax.tree_util.tree_leaves(
+        in_specs, is_leaf=lambda x: hasattr(x, "mesh")
+    ) if in_specs is not None else []:
+        m = getattr(entry, "mesh", None)
+        if m is not None and hasattr(m, "mesh_dim_names"):
+            sizes.update(dict(zip(m.mesh_dim_names, m.shape)))
+    return sizes
+
+
+def shardcheck(
+    fn,
+    *args,
+    in_specs=None,
+    donate_argnums: Optional[Sequence[int]] = (),
+    static_argnums: Sequence[int] = (),
+    mesh=None,
+    name: Optional[str] = None,
+    min_bytes: int = 1 << 20,
+    check_source: bool = True,
+    **kwargs,
+) -> FindingReport:
+    """Statically analyze ``fn(*args, **kwargs)`` for placement hazards.
+
+    ``args`` may be real (sharded) jax arrays, ``ShapeDtypeStruct``s, or any
+    pytrees thereof.  Input shardings come from, in priority order:
+    ``in_specs`` (a pytree matching ``args`` whose leaves are DArraySpec /
+    PartitionSpec / SymSharding / None), then each array leaf's own
+    ``NamedSharding``, else replicated.  ``mesh`` (a DeviceMesh or jax Mesh)
+    supplies axis sizes when no sharded leaf carries one.
+
+    ``donate_argnums``: the donation the caller's jit uses — inputs that are
+    rebuilt as same-shape outputs but NOT donated raise VSC105 (they double
+    the resident footprint of params/optimizer state).  Pass ``None`` when
+    the caller's donation is UNKNOWN (e.g. analyzing someone else's jitted
+    fn): the donation check is skipped rather than guessed.
+
+    ``static_argnums``: the caller's jit static args — excluded from the
+    trace (and from the input-leaf/spec alignment), exactly as the caller's
+    ``jax.jit(fn, static_argnums=...)`` treats them.
+
+    ``min_bytes``: findings about operands smaller than this are suppressed
+    (default 1 MiB — a gathered scalar is not a hazard).
+    """
+    import jax
+
+    report = FindingReport(name or getattr(fn, "__name__", "program"))
+
+    inner = getattr(fn, "_jitted", fn)  # make_train_step exposes the raw jit
+    static_set = set(static_argnums or ())
+    try:
+        closed = jax.make_jaxpr(inner, static_argnums=tuple(static_set))(*args, **kwargs)
+    except Exception as e:
+        report.add(Finding(
+            CODES["VSC109"],
+            f"could not trace program for shardcheck: {e!r}",
+            severity=Severity.INFO,
+        ))
+        return report
+
+    # dynamic input leaves, in invar order (static args produce no invars)
+    dyn_leaves: List[Any] = []
+    arg_of_leaf: List[int] = []
+    for i, a in enumerate(args):
+        if i in static_set:
+            continue
+        ls = jax.tree_util.tree_leaves(a)
+        dyn_leaves.extend(ls)
+        arg_of_leaf.extend([i] * len(ls))
+    kw_leaves = jax.tree_util.tree_leaves(kwargs)
+    dyn_leaves.extend(kw_leaves)
+    arg_of_leaf.extend([-1] * len(kw_leaves))
+
+    spec_leaves: List[Any]
+    if in_specs is not None:
+        spec_leaves = jax.tree_util.tree_leaves(
+            in_specs, is_leaf=lambda x: x is None or not isinstance(x, (list, dict, tuple))
+        )
+        if len(spec_leaves) != len(dyn_leaves):
+            spec_leaves = list(spec_leaves) + [None] * (len(dyn_leaves) - len(spec_leaves))
+    else:
+        spec_leaves = [None] * len(dyn_leaves)
+
+    in_syms = [
+        _leaf_sym(leaf, entry, len(getattr(leaf, "shape", ())))
+        for leaf, entry in zip(dyn_leaves, spec_leaves)
+    ]
+    axis_sizes = _axis_sizes_from((args, kwargs), in_specs, mesh)
+
+    checker = _Checker(axis_sizes, report, min_bytes)
+    try:
+        checker.run(closed, in_syms)
+    except Exception as e:
+        report.add(Finding(
+            CODES["VSC109"],
+            f"shardcheck walk aborted: {e!r}",
+            severity=Severity.INFO,
+        ))
+
+    if donate_argnums is not None:
+        _check_donation(report, closed, arg_of_leaf, donate_argnums, min_bytes)
+
+    if check_source:
+        _check_fn_source(report, fn)
+    return report
+
+
+def shardcheck_jaxpr(
+    closed_jaxpr,
+    in_syms: Sequence[SymSharding],
+    axis_sizes: Dict[str, int],
+    name: str = "jaxpr",
+    min_bytes: int = 1 << 20,
+) -> FindingReport:
+    """The raw engine: analyze an already-traced ClosedJaxpr with explicit
+    per-invar symbolic shardings (what auto-plan v2's scorer calls)."""
+    report = FindingReport(name)
+    checker = _Checker(axis_sizes, report, min_bytes)
+    try:
+        checker.run(closed_jaxpr, list(in_syms))
+    except Exception as e:
+        report.add(Finding(
+            CODES["VSC109"], f"shardcheck walk aborted: {e!r}",
+            severity=Severity.INFO,
+        ))
+    return report
+
+
+def _check_donation(report, closed, arg_of_leaf, donate_argnums, min_bytes) -> None:
+    donate_argnums = set(donate_argnums or ())
+    invars = closed.jaxpr.invars
+    outvars = closed.jaxpr.outvars
+    out_sigs: Dict[Tuple, int] = {}
+    for v in outvars:
+        aval = getattr(v, "aval", None)
+        if aval is None or not getattr(aval, "shape", None):
+            continue
+        key = (tuple(aval.shape), np.dtype(aval.dtype).str)
+        out_sigs[key] = out_sigs.get(key, 0) + 1
+    missed = 0
+    missed_bytes = 0
+    for idx, v in enumerate(invars):
+        if idx >= len(arg_of_leaf):
+            break
+        argnum = arg_of_leaf[idx]
+        if argnum < 0 or argnum in donate_argnums:
+            continue
+        aval = v.aval
+        if not getattr(aval, "shape", None):
+            continue
+        b = _full_bytes(aval)
+        if b < min_bytes:
+            continue
+        key = (tuple(aval.shape), np.dtype(aval.dtype).str)
+        if out_sigs.get(key, 0) > 0:
+            out_sigs[key] -= 1
+            missed += 1
+            missed_bytes += b
+    if missed:
+        report.add(Finding(
+            CODES["VSC105"],
+            f"{missed} large input buffer(s) (~{missed_bytes / 2**20:.1f} MiB "
+            "logical) are rebuilt as same-shape outputs but not donated — "
+            "each lives twice during the step (pass donate_argnums)",
+            bytes_est=missed_bytes,
+        ))
+
+
+def _check_fn_source(report, fn) -> None:
+    """VSC104 on the checked callable's own source, when retrievable."""
+    import inspect
+
+    try:
+        target = getattr(fn, "__wrapped__", fn)
+        src = inspect.getsource(inspect.unwrap(target))
+        filename = inspect.getsourcefile(inspect.unwrap(target)) or "<source>"
+    except (OSError, TypeError):
+        return
+    import textwrap
+
+    from .lint import rank_divergence_findings
+
+    try:
+        report.extend(rank_divergence_findings(textwrap.dedent(src), filename))
+    except SyntaxError:
+        pass
+
+
+# ------------------------------------------------- redistribute / pipeline
+def check_transition(src_spec, dst_spec, where: Optional[str] = None) -> List[Finding]:
+    """Findings for one ``redistribute(src -> dst)``: VSC106 (error, with
+    the planner's structured decline code in the message) when the move
+    would hit the logical-materializing fallback; VSC108 (info, costed)
+    when the multi-hop planner serves it."""
+    from ..redistribute import classify_transition
+    from ..redistribute_plan import decline_finding, plan_redistribute
+
+    if src_spec == dst_spec:
+        return []
+    tier = classify_transition(src_spec, dst_spec)
+    label = where or f"{list(map(str, src_spec.placements))} -> {list(map(str, dst_spec.placements))}"
+    if tier == "fallback":
+        decline = decline_finding(src_spec, dst_spec)
+        df = decline.finding()
+        df.where = label
+        return [Finding(
+            CODES["VSC106"],
+            f"transition would materialize the logical tensor "
+            f"(~{src_spec.logical_bytes() / 2**20:.1f} MiB vs "
+            f"~{max(src_spec.per_shard_bytes(), dst_spec.per_shard_bytes()) / 2**20:.1f} MiB "
+            f"per shard); planner declined [{decline.code}]: {decline.message}",
+            where=label,
+            bytes_est=src_spec.logical_bytes(),
+        ), df]
+    if tier == "planned":
+        plan = plan_redistribute(src_spec, dst_spec)
+        if plan is not None:
+            return [Finding(
+                CODES["VSC108"],
+                f"resolved by a {len(plan.hops)}-hop plan moving "
+                f"~{plan.bytes_moved / 2**20:.2f} MiB per device",
+                where=label,
+                bytes_est=plan.bytes_moved,
+            )]
+    return []
+
+
+def check_stage_boundaries(
+    out_specs: Sequence,
+    in_specs: Sequence,
+    labels: Optional[Sequence[str]] = None,
+    name: str = "pipeline",
+) -> FindingReport:
+    """Cross-stage resharding audit for a pipeline split: stage i's output
+    spec vs stage i+1's input spec, each boundary classified through the
+    REAL redistribute dispatch (VSC106 on fallback, VSC108 info when the
+    multi-hop planner carries it)."""
+    report = FindingReport(name)
+    for i, (o, nxt) in enumerate(zip(out_specs, in_specs)):
+        if o is None or nxt is None:
+            continue
+        label = labels[i] if labels and i < len(labels) else f"stage{i}->stage{i + 1}"
+        report.extend(check_transition(o, nxt, where=label))
+    return report
+
+
+def check_param_plan(param_plan: Dict[str, Any], mesh, name: str = "param_plan") -> FindingReport:
+    """VSC107 audit of a dmodule parameter plan: placements that are never
+    right for parameters (Partial — a param is a value, not a pending
+    reduction) or that cannot bind to the mesh (axis index out of range)."""
+    from ..placements import normalize_placements
+
+    report = FindingReport(name)
+    for pattern, placements in (param_plan or {}).items():
+        try:
+            normalized = normalize_placements(placements, mesh.ndim, None)
+        except ValueError as e:
+            report.add(Finding(
+                CODES["VSC107"],
+                f"plan entry {pattern!r} does not normalize: {e}",
+                where=pattern,
+                severity=Severity.ERROR,
+            ))
+            continue
+        for i, p in enumerate(normalized):
+            if p.is_partial():
+                report.add(Finding(
+                    CODES["VSC107"],
+                    f"plan entry {pattern!r} places a parameter as Partial on "
+                    f"mesh dim {i} — parameters are values, not pending "
+                    "reductions; use Replicate (grads sync via GSPMD)",
+                    where=pattern,
+                    mesh_dim=mesh.mesh_dim_names[i] if i < len(mesh.mesh_dim_names) else None,
+                    severity=Severity.ERROR,
+                ))
+    return report

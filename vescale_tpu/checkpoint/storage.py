@@ -163,9 +163,11 @@ class AsyncWriter:
         self.pool = _fut.ThreadPoolExecutor(max_workers=max(2, num_workers))
         self.futures: List[_fut.Future] = []
         self._native = None
-        if isinstance(storage, FileSystemStorage) and os.environ.get(
-            "VESCALE_NATIVE_CKPT_IO", "1"
-        ) != "0":
+        from ..analysis import envreg
+
+        if isinstance(storage, FileSystemStorage) and envreg.get_bool(
+            "VESCALE_NATIVE_CKPT_IO"
+        ):
             from .native_io import NativeWritePool
 
             self._native = NativeWritePool.get(num_workers)

@@ -3,7 +3,6 @@ per-rank operation/communication logging gated by VESCALE_DEBUG_MODE."""
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 from typing import Any, Iterable, Optional
@@ -23,7 +22,9 @@ class DebugLogger:
     @classmethod
     def enabled(cls) -> bool:
         if cls._enabled is None:
-            v = os.environ.get("VESCALE_DEBUG_MODE", "")
+            from ..analysis import envreg
+
+            v = envreg.get_str("VESCALE_DEBUG_MODE") or ""
             if not v or v == "0":
                 cls._enabled, cls._ranks = False, None
             elif v == "1":

@@ -12,7 +12,7 @@ from typing import Optional, Sequence, Tuple, Union
 
 from .mesh import DeviceMesh, init_device_mesh as _init
 
-__all__ = ["VeDeviceMesh", "VESCALE_DEVICE_MESH"]
+__all__ = ["VeDeviceMesh", "VESCALE_DEVICE_MESH"]  # vescale-lint: disable=VSC202 (API singleton name, not an env var)
 
 
 class VeDeviceMesh:

@@ -63,10 +63,11 @@ class BarrierTimeout(RuntimeError):
 def _resolve_timeout(timeout_s: Optional[float]) -> Optional[float]:
     """None -> VESCALE_BARRIER_TIMEOUT (unset = no timeout); <= 0 disables."""
     if timeout_s is None:
-        env = os.environ.get("VESCALE_BARRIER_TIMEOUT")
-        if not env:
+        from .analysis import envreg
+
+        timeout_s = envreg.get_float("VESCALE_BARRIER_TIMEOUT")
+        if timeout_s is None:
             return None
-        timeout_s = float(env)
     return timeout_s if timeout_s > 0 else None
 
 
@@ -151,11 +152,13 @@ def initialize(
     global _INITIALIZED
     if _INITIALIZED:
         return
-    coordinator_address = coordinator_address or os.environ.get("VESCALE_COORDINATOR")
-    if num_processes is None and "VESCALE_NUM_PROCESSES" in os.environ:
-        num_processes = int(os.environ["VESCALE_NUM_PROCESSES"])
-    if process_id is None and "VESCALE_PROCESS_ID" in os.environ:
-        process_id = int(os.environ["VESCALE_PROCESS_ID"])
+    from .analysis import envreg
+
+    coordinator_address = coordinator_address or envreg.get_str("VESCALE_COORDINATOR")
+    if num_processes is None:
+        num_processes = envreg.get_int("VESCALE_NUM_PROCESSES")
+    if process_id is None:
+        process_id = envreg.get_int("VESCALE_PROCESS_ID")
     if num_processes is not None and num_processes > 1:
         # CPU multi-process (the spawned-worker test rig): the default CPU
         # client has NO cross-process collectives ("Multiprocess

@@ -209,6 +209,20 @@ class DModule:
         self._fwd_matched: set = set()
         self._param_matched: set = set()
         self._warned_fwd = False
+        # static plan validation (analysis/shardcheck.py VSC107): Partial
+        # params, un-normalizable entries.  Mode-gated (VESCALE_SHARDCHECK):
+        # warn surfaces one aggregated warning, strict raises before any
+        # parameter is materialized wrong
+        if validate_plan and self.param_plan:
+            from .. import analysis as _analysis
+
+            if _analysis.enabled():
+                _analysis.dispatch_report(
+                    _analysis.check_param_plan(
+                        self.param_plan, device_mesh, name="dmodule parameter plan"
+                    ),
+                    stacklevel=3,
+                )
 
     # --------------------------------------------------------- param plans
     def param_placements(self, path: str, ndim: int) -> Tuple[Placement, ...]:

@@ -543,6 +543,20 @@ def _bwd_4d(q, k, v, o, do, lse, scale, causal, block_q, block_k, interpret):
 # ring/ulysses (parallel/context.py) instead.
 
 
+def _def_partition(cp, **kwargs) -> None:
+    """``custom_partitioning.def_partition`` across jax versions: newer jax
+    grew ``sharding_rule`` (shardy) and ``need_replication_factors``; jax
+    0.4.x has neither.  Keyword args the installed signature doesn't accept
+    are dropped — the explicit ``partition``/``infer_sharding_from_operands``
+    callbacks (always passed) carry the same contract for GSPMD, so older
+    versions lose nothing but the shardy-path rule.  The same shim idea as
+    ``collectives.shard_map`` (check_vma/check_rep)."""
+    import inspect as _inspect
+
+    params = frozenset(_inspect.signature(type(cp).def_partition).parameters)
+    cp.def_partition(**{k: v for k, v in kwargs.items() if k in params})
+
+
 def _batch_head_axes(mesh, arg_shapes):
     """(batch_axes, head_axes) of the q operand's (suggested) sharding.
 
@@ -594,7 +608,8 @@ def _partitioned_fwd(scale, causal, block_q, block_k, interpret):
         # needs tp | KV, which every llama/mixtral plan in-tree satisfies
         return mesh, lower, (qsh, lsh), (qsh, qsh, qsh)
 
-    fwd.def_partition(
+    _def_partition(
+        fwd,
         partition=partition,
         infer_sharding_from_operands=infer,
         sharding_rule="b t h d, b t g d, b t g d -> b t h d, b h t",
@@ -627,7 +642,8 @@ def _partitioned_bwd(scale, causal, block_q, block_k, interpret):
 
         return mesh, lower, (qsh, qsh, qsh), (qsh, qsh, qsh, qsh, qsh, lsh)
 
-    bwd.def_partition(
+    _def_partition(
+        bwd,
         partition=partition,
         infer_sharding_from_operands=infer,
         sharding_rule=(

@@ -119,6 +119,36 @@ class PipeEngine:
             for m in range(num_microbatches)
         ]
 
+    def _check_stage_boundaries(self, micro) -> None:
+        """One-time static audit of the plan's declared cross-stage
+        activation layouts (PipelineParallelPlan.stage_out/in_placements)
+        through analysis/shardcheck: a boundary whose resharding would hit
+        the materializing fallback raises (strict) or warns (warn mode)
+        BEFORE the first microbatch runs.  The p2p tensor shape comes from
+        the plan (``p2p_tensor_shapes``) when declared, else the first
+        microbatch leaf."""
+        if getattr(self, "_boundaries_checked", False):
+            return
+        self._boundaries_checked = True
+        plan = self.plan
+        if self.mesh is None or getattr(plan, "stage_out_placements", None) is None:
+            return
+        from .. import analysis
+
+        if not analysis.enabled():
+            return
+        shapes = plan.p2p_tensor_shapes
+        if shapes:
+            shape = shapes[0] if isinstance(shapes[0], (tuple, list)) else shapes
+        else:
+            leaves = jax.tree_util.tree_leaves(micro[0]) if micro else []
+            if not leaves:
+                return
+            shape = leaves[0].shape
+        analysis.dispatch_report(
+            plan.boundary_report(self.mesh, tuple(shape)), stacklevel=4
+        )
+
     # ------------------------------------------------------------- main
     def forward_backward(
         self,
@@ -147,6 +177,7 @@ class PipeEngine:
         micro = self._split_microbatches(
             {k: v for k, v in minibatch.items() if k != "target"}, M
         )
+        self._check_stage_boundaries(micro)
         has_target = "target" in minibatch
         if not has_target and not forward_only:
             raise ValueError("training forward_backward requires a 'target' in the minibatch")

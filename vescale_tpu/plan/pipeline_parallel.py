@@ -32,9 +32,51 @@ class PipelineParallelPlan:
     # weights): routes scheduling through the cost-graph generator, the
     # analog of the reference's profiled CostGraph (zero_bubble_v.py:198)
     schedule_costs: Optional[Any] = None
+    # static cross-stage activation layouts (analysis/shardcheck.py VSC106):
+    # per boundary i, the placements stage i EMITS and stage i+1 EXPECTS.
+    # When both are declared, the pipe engine audits every boundary through
+    # the real redistribute dispatch before the first microbatch runs — a
+    # boundary whose transition would hit the logical-materializing
+    # fallback surfaces as a coded finding, not a silent gather at step 1.
+    stage_out_placements: Optional[Sequence[Any]] = None
+    stage_in_placements: Optional[Sequence[Any]] = None
 
     def __post_init__(self):
         if self.schedule_type == PipelineScheduleType.INTERLEAVED_1F1B and self.virtual_chunks < 2:
             self.virtual_chunks = max(2, self.num_model_chunks)
         if self.use_zero_bubble:
             self.schedule_type = PipelineScheduleType.ZERO_BUBBLE
+        if (self.stage_out_placements is None) != (self.stage_in_placements is None):
+            raise ValueError(
+                "stage_out_placements and stage_in_placements must be "
+                "declared together (one per stage boundary)"
+            )
+        if self.stage_out_placements is not None and (
+            len(self.stage_out_placements) != len(self.stage_in_placements)
+        ):
+            raise ValueError(
+                "stage_out_placements and stage_in_placements must have "
+                "equal length (one entry per stage boundary)"
+            )
+
+    def boundary_report(self, mesh, activation_shape, dtype=None):
+        """Audit the declared cross-stage activation layouts over ``mesh``
+        for ``activation_shape`` p2p tensors: every boundary transition is
+        classified through the real redistribute dispatch —
+        materializing-fallback boundaries emit VSC106 (with the planner's
+        VSC12x decline code), planner-served ones emit the costed VSC108
+        info finding.  Returns the FindingReport (empty when no layouts
+        are declared)."""
+        from ..analysis import check_stage_boundaries
+        from ..spec import DArraySpec, TensorMeta
+
+        if self.stage_out_placements is None:
+            from ..analysis.findings import FindingReport
+
+            return FindingReport("pipeline boundaries")
+        import jax.numpy as jnp
+
+        meta = TensorMeta(tuple(activation_shape), jnp.dtype(dtype or jnp.float32))
+        outs = [DArraySpec(mesh, p, meta) for p in self.stage_out_placements]
+        ins = [DArraySpec(mesh, p, meta) for p in self.stage_in_placements]
+        return check_stage_boundaries(outs, ins, name="pipeline boundaries")

@@ -199,10 +199,10 @@ _warned_pairs = set()
 
 
 def _warn_fallback(src: DArraySpec, dst: DArraySpec) -> None:
-    import os
     import warnings
 
     from . import telemetry as _tel
+    from .analysis import envreg
     from .debug import DebugLogger
     from .redistribute_plan import decline_reason
 
@@ -221,7 +221,7 @@ def _warn_fallback(src: DArraySpec, dst: DArraySpec) -> None:
         f"~{shard / 2**20:.1f} MiB per-shard; multi-hop planner declined: "
         f"{decline_reason(src, dst)}"
     )
-    if os.environ.get("VESCALE_STRICT_REDISTRIBUTE", "0").lower() not in ("", "0", "false"):
+    if envreg.get_bool("VESCALE_STRICT_REDISTRIBUTE"):
         raise RuntimeError(msg + " (VESCALE_STRICT_REDISTRIBUTE=1)")
     key = (src, dst)
     if key not in _warned_pairs:

@@ -45,26 +45,47 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import itertools
-import os
 import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import jax
 
+from .analysis import envreg
 from .placements import transition_candidates
 from .spec import DArraySpec
 
 __all__ = [
     "PlanHop",
     "RedistributePlan",
+    "Decline",
     "plan_redistribute",
     "decline_reason",
+    "decline_finding",
     "plan_comm_summary",
     "can_redistribute_per_shard",
     "clear_plan_cache",
     "plan_cache_stats",
 ]
+
+
+@dataclasses.dataclass(frozen=True)
+class Decline:
+    """A structured planner decline: a stable ``VSC12x`` code from the
+    shared findings vocabulary (analysis/findings.py) + the human reason.
+    Replaces the free-form reason strings: ``_warn_fallback``, shardcheck's
+    VSC106 and docs/known_failures.md all key on ``code``."""
+
+    code: str  # "VSC120".."VSC126"
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.code}] {self.message}"
+
+    def finding(self):
+        from .analysis.findings import CODES, Finding
+
+        return Finding(CODES[self.code], self.message)
 
 # per-byte cost weights on a torus: all-to-all keeps each link at 1/n of the
 # payload, reduce-scatter streams the ring once, all-gather delivers (n-1)/n
@@ -87,11 +108,11 @@ _HOP_LATENCY = 64 * 1024
 
 
 def _mem_factor() -> float:
-    return float(os.environ.get("VESCALE_REDISTRIBUTE_MEM_FACTOR", "4"))
+    return envreg.get_float("VESCALE_REDISTRIBUTE_MEM_FACTOR")
 
 
 def _max_hops() -> int:
-    return int(os.environ.get("VESCALE_REDISTRIBUTE_MAX_HOPS", "3"))
+    return envreg.get_int("VESCALE_REDISTRIBUTE_MAX_HOPS")
 
 
 @dataclasses.dataclass
@@ -296,9 +317,9 @@ def _candidate_specs(src: DArraySpec, dst: DArraySpec) -> List[DArraySpec]:
 
 def _search_same_mesh(
     src: DArraySpec, dst: DArraySpec
-) -> Tuple[Optional[List[PlanHop]], str]:
+) -> Tuple[Optional[List[PlanHop]], Optional[Decline]]:
     """Bounded Dijkstra src -> dst over the candidate lattice.  Returns
-    (hops, "") or (None, decline reason)."""
+    (hops, None) or (None, structured decline)."""
     nodes = _candidate_specs(src, dst)
     if dst not in nodes:
         nodes.append(dst)
@@ -318,7 +339,7 @@ def _search_same_mesh(
     while heap:
         cost, hops, _, spec, path = heapq.heappop(heap)
         if spec == dst:
-            return path, ""
+            return path, None
         if hops >= max_hops or cost > best.get((spec, hops), float("inf")):
             continue
         for nxt in nodes:
@@ -340,12 +361,15 @@ def _search_same_mesh(
                 best[(nxt, hops + 1)] = c
                 heapq.heappush(heap, (c, hops + 1, next(tie), nxt, path + [e]))
     if over_budget:
-        return None, (
+        return None, Decline("VSC120", (
             "every candidate path needs an intermediate above the per-shard "
             f"memory budget ({_mem_factor():g}x the larger endpoint shard; "
             "raise VESCALE_REDISTRIBUTE_MEM_FACTOR to trade memory for locality)"
-        )
-    return None, f"no per-shard hop sequence within {max_hops} hops over the candidate lattice"
+        ))
+    return None, Decline(
+        "VSC121",
+        f"no per-shard hop sequence within {max_hops} hops over the candidate lattice",
+    )
 
 
 def _materialize(hops: List[PlanHop]) -> Tuple[PlanHop, ...]:
@@ -387,7 +411,7 @@ def _unpadded_bridge(spec: DArraySpec) -> Optional[DArraySpec]:
 
 def _plan_cross_mesh(
     src: DArraySpec, dst: DArraySpec
-) -> Tuple[Optional[RedistributePlan], str]:
+) -> Tuple[Optional[RedistributePlan], Optional[Decline]]:
     """Bridge meshes through plain unpadded specs: plan src -> plain on the
     source mesh, device_put the shards across, plan plain -> dst on the
     destination mesh (the reference CrossMeshRedistribute round-trips the
@@ -395,20 +419,24 @@ def _plan_cross_mesh(
     mid = _unpadded_bridge(src)
     dmid = _unpadded_bridge(dst)
     if mid is None or dmid is None:
-        return None, "cross-mesh: a side has no plain unpadded per-shard bridge form"
+        return None, Decline(
+            "VSC122", "cross-mesh: a side has no plain unpadded per-shard bridge form"
+        )
     budget = _mem_factor() * max(src.per_shard_bytes(), dst.per_shard_bytes())
     for s in (mid, dmid):
         if s not in (src, dst) and s.per_shard_bytes() > budget:
-            return None, (
+            return None, Decline("VSC123", (
                 "cross-mesh: the unpadded bridge spec exceeds the per-shard "
                 f"memory budget ({_mem_factor():g}x the larger endpoint shard; "
                 "raise VESCALE_REDISTRIBUTE_MEM_FACTOR to trade memory for locality)"
-            )
+            ))
     hops: List[PlanHop] = []
     if mid != src:
         sub, reason = _search_same_mesh(src, mid)
         if sub is None:
-            return None, f"cross-mesh: source-side strip failed — {reason}"
+            return None, Decline(
+                "VSC124", f"cross-mesh: source-side strip failed — {reason}"
+            )
         hops.extend(sub)
     hops.append(
         PlanHop(
@@ -424,9 +452,11 @@ def _plan_cross_mesh(
     if dmid != dst:
         sub, reason = _search_same_mesh(dmid, dst)
         if sub is None:
-            return None, f"cross-mesh: destination-side dress failed — {reason}"
+            return None, Decline(
+                "VSC125", f"cross-mesh: destination-side dress failed — {reason}"
+            )
         hops.extend(sub)
-    return RedistributePlan(src, dst, _materialize(hops)), ""
+    return RedistributePlan(src, dst, _materialize(hops)), None
 
 
 # ---------------------------------------------------------------- LRU cache
@@ -464,7 +494,7 @@ class _LRU:
 
 
 _PLANS = _LRU(512)
-_DECLINES = _LRU(512)  # (src, dst) -> reason string
+_DECLINES = _LRU(512)  # (src, dst, knobs) -> Decline
 
 
 def plan_redistribute(src: DArraySpec, dst: DArraySpec) -> Optional[RedistributePlan]:
@@ -491,16 +521,26 @@ def plan_redistribute(src: DArraySpec, dst: DArraySpec) -> Optional[Redistribute
         hops, reason = _search_same_mesh(src, dst)
         plan = RedistributePlan(src, dst, _materialize(hops)) if hops is not None else None
     if plan is None:
-        _DECLINES.put(key, reason or "unknown")
+        _DECLINES.put(key, reason or Decline("VSC121", "unknown"))
         return None
     _PLANS.put(key, plan)
     return plan
 
 
+_NOT_CONSULTED = Decline("VSC126", "planner was not consulted for this pair")
+
+
+def decline_finding(src: DArraySpec, dst: DArraySpec) -> Decline:
+    """The structured decline for (src, dst): a ``VSC12x``-coded
+    :class:`Decline` (VSC126 when the planner never saw the pair)."""
+    d = _DECLINES.get((src, dst, _mem_factor(), _max_hops()))
+    return d if d is not None else _NOT_CONSULTED
+
+
 def decline_reason(src: DArraySpec, dst: DArraySpec) -> str:
-    """Why the planner declined (src, dst) — for the fallback warning."""
-    reason = _DECLINES.get((src, dst, _mem_factor(), _max_hops()))
-    return reason if reason is not None else "planner was not consulted for this pair"
+    """Why the planner declined (src, dst) — for the fallback warning.
+    Human-readable rendering of :func:`decline_finding` (``[VSC12x] why``)."""
+    return str(decline_finding(src, dst))
 
 
 def can_redistribute_per_shard(src: DArraySpec, dst: DArraySpec) -> bool:

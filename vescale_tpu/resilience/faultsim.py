@@ -54,7 +54,6 @@ Callers must use ``faultsim.check(...)`` attribute access, never
 
 from __future__ import annotations
 
-import os
 import threading
 import zlib
 from dataclasses import dataclass, field
@@ -111,9 +110,11 @@ def _process_rank() -> int:
     bootstrap (set before jax initializes in spawned-worker rigs) so a
     schedule can be parsed and filtered without touching jax; falls back
     to ``jax.process_index()``."""
-    env = os.environ.get("VESCALE_PROCESS_ID")
+    from ..analysis import envreg
+
+    env = envreg.get_int("VESCALE_PROCESS_ID")
     if env is not None:
-        return int(env)
+        return env
     try:
         import jax
 
@@ -295,8 +296,17 @@ def parse_schedule(text: str) -> List[Fault]:
 
 
 def arm_from_env(var: str = "VESCALE_FAULTSIM") -> Optional[FaultInjector]:
-    """Arm from the env schedule if set (scripted runs); None otherwise."""
-    text = os.environ.get(var)
+    """Arm from the env schedule if set (scripted runs); None otherwise.
+    ``var`` may name any env var (custom harnesses); only registered
+    VESCALE_* names route through the registry."""
+    from ..analysis import envreg
+
+    if envreg.is_registered(var):
+        text = envreg.get_str(var)
+    else:
+        import os
+
+        text = os.environ.get(var)  # vescale-lint: disable=VSC201 (caller-chosen non-registry name)
     if not text:
         return None
     return arm(parse_schedule(text))

@@ -80,7 +80,6 @@ same program on the same data from checkpoint-roundtripped state
 from __future__ import annotations
 
 import math
-import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -242,7 +241,9 @@ def run_resilient(
     # ---------------------------------------------- consistency arming
     checker = consistency
     if checker is None:
-        env_every = os.environ.get("VESCALE_CONSISTENCY_EVERY")
+        from ..analysis import envreg
+
+        env_every = envreg.get_raw("VESCALE_CONSISTENCY_EVERY")
         n = consistency_every if consistency_every is not None else (
             int(env_every) if env_every else 32
         )
@@ -460,7 +461,9 @@ def run_resilient(
             if _fs.fires("hang", ctx=f"step{step}"):
                 # simulated wedged collective: stall far past any deadline —
                 # the watchdog's detect/dump/abort path is the way out
-                time.sleep(float(os.environ.get("VESCALE_FAULTSIM_HANG_S", "3600")))
+                from ..analysis import envreg
+
+                time.sleep(envreg.get_float("VESCALE_FAULTSIM_HANG_S"))
             if _fs.fires("preempt", ctx=f"step{step}"):
                 handler.request()
             # coordinated mode: one control-plane allgather — agreed

@@ -31,7 +31,6 @@ anything but wall clock.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from dataclasses import dataclass
@@ -67,19 +66,19 @@ class RetryPolicy:
     name: str = "io"
 
     @classmethod
-    def from_env(cls, attempts_var: str, default_attempts: int = 3, name: str = "io"):
-        def _f(var: str, dflt: float) -> float:
-            try:
-                return float(os.environ.get(var, dflt))
-            except ValueError:
-                return dflt
+    def from_env(cls, attempts_var: str, name: str = "io"):
+        """Policy from the env registry: ``attempts_var`` must be a
+        registered VESCALE_*_RETRIES knob (its declared default applies
+        when unset — there is deliberately no shadow default here)."""
+        from ..analysis import envreg
 
+        attempts = envreg.get_int(attempts_var)
         return cls(
-            max_attempts=max(1, int(_f(attempts_var, default_attempts))),
-            base_backoff=_f("VESCALE_IO_BACKOFF_BASE", 0.05),
-            max_backoff=_f("VESCALE_IO_BACKOFF_MAX", 5.0),
-            jitter=_f("VESCALE_IO_BACKOFF_JITTER", 0.25),
-            attempt_timeout=_f("VESCALE_IO_ATTEMPT_TIMEOUT", 0.0),
+            max_attempts=max(1, attempts if attempts is not None else 1),
+            base_backoff=envreg.get_float("VESCALE_IO_BACKOFF_BASE"),
+            max_backoff=envreg.get_float("VESCALE_IO_BACKOFF_MAX"),
+            jitter=envreg.get_float("VESCALE_IO_BACKOFF_JITTER"),
+            attempt_timeout=envreg.get_float("VESCALE_IO_ATTEMPT_TIMEOUT"),
             name=name,
         )
 
@@ -182,7 +181,7 @@ def ckpt_policy() -> RetryPolicy:
     if _CKPT is None:
         with _LOCK:
             if _CKPT is None:
-                _CKPT = RetryPolicy.from_env("VESCALE_CKPT_RETRIES", 3, name="ckpt_io")
+                _CKPT = RetryPolicy.from_env("VESCALE_CKPT_RETRIES", name="ckpt_io")
     return _CKPT
 
 
@@ -191,7 +190,7 @@ def loader_policy() -> RetryPolicy:
     if _LOADER is None:
         with _LOCK:
             if _LOADER is None:
-                _LOADER = RetryPolicy.from_env("VESCALE_LOADER_RETRIES", 3, name="loader")
+                _LOADER = RetryPolicy.from_env("VESCALE_LOADER_RETRIES", name="loader")
                 # native-loader failures surface as RuntimeError, not OSError
                 _LOADER.retry_on = (OSError, RuntimeError, TimeoutError)
     return _LOADER

@@ -232,7 +232,9 @@ class Watchdog:
             st = _api.get_state()
             root = st.out_dir if st is not None else None
             if root is None:
-                root = os.environ.get("VESCALE_WATCHDOG_DIR")
+                from ..analysis import envreg
+
+                root = envreg.get_str("VESCALE_WATCHDOG_DIR")
         if root is None:
             return None
         from .faultsim import _process_rank
@@ -255,14 +257,15 @@ class Watchdog:
         deadline (an explicit 0 disables even with the env set) while
         abort/exit-code still come from the env — the single parser both
         direct callers and ``run_resilient`` share."""
+        from ..analysis import envreg
+
         if timeout_s is None:
-            raw = os.environ.get("VESCALE_WATCHDOG_TIMEOUT")
-            timeout_s = float(raw) if raw else 0.0
-        if timeout_s <= 0:
+            timeout_s = envreg.get_float("VESCALE_WATCHDOG_TIMEOUT")
+        if timeout_s is None or timeout_s <= 0:
             return None
         return cls(
             timeout_s=float(timeout_s),
-            abort=os.environ.get("VESCALE_WATCHDOG_ABORT", "1") == "1",
-            exit_code=int(os.environ.get("VESCALE_WATCHDOG_EXIT_CODE", DEFAULT_EXIT_CODE)),
+            abort=envreg.get_bool("VESCALE_WATCHDOG_ABORT"),
+            exit_code=envreg.get_int("VESCALE_WATCHDOG_EXIT_CODE"),
             dump_dir=dump_dir,
         )

@@ -44,6 +44,7 @@ def build_step_report(
     static_argnums=(),
     name: str = "step",
     aot_report=None,
+    donate_argnums=None,
     **kwargs,
 ) -> Dict[str, Any]:
     """Lower+compile ``fn(*args, **kwargs)`` (or reuse ``fn.lower`` when fn
@@ -60,7 +61,11 @@ def build_step_report(
     ``aot_report`` (path or loaded AOT_*_REPORT.json dict): attaches an
     ``aot_drift`` section diffing the measured memory footprint against the
     AOT budget (memory_report.compare_with_aot; None when either side lacks
-    a usable byte count)."""
+    a usable byte count).
+
+    ``donate_argnums``: the donation the jit of ``fn`` uses — forwarded to
+    the shardcheck section so donated steps are not falsely flagged VSC105;
+    None (default) skips the donation check."""
     if hasattr(fn, "lower"):
         lowered = fn.lower(*args, **kwargs)
     else:
@@ -114,7 +119,32 @@ def build_step_report(
         from .memory_report import compare_with_aot
 
         report["aot_drift"] = compare_with_aot(report, aot_report)
+    _attach_shardcheck(report, fn, args, kwargs, name, donate_argnums, static_argnums)
     return report
+
+
+def _attach_shardcheck(report, fn, args, kwargs, name, donate_argnums,
+                       static_argnums=()) -> None:
+    """Static placement findings for the SAME program the report describes
+    (analysis/shardcheck.py), keyed ``shardcheck`` — input shardings read
+    off the argument arrays' own NamedShardings.  Gated by
+    ``VESCALE_SHARDCHECK`` (off -> no section); never fails the report.
+    ``donate_argnums``: forwarded from the caller; ``None`` (the default —
+    the report builder cannot see what the caller's jit donates) skips the
+    VSC105 donation check rather than falsely flagging donated steps."""
+    from .. import analysis
+
+    if not analysis.enabled():
+        return
+    try:
+        findings = analysis.shardcheck(
+            fn, *args, name=name, check_source=False,
+            donate_argnums=donate_argnums, static_argnums=static_argnums,
+            **kwargs
+        )
+        report["shardcheck"] = findings.to_dict()
+    except Exception as e:  # degrade, never fail a run for observability
+        report["shardcheck"] = {"error": repr(e)}
 
 
 def write_step_report(report: Dict[str, Any], path: str) -> str:
