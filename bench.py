@@ -613,6 +613,85 @@ def bench_watchdog():
     }))
 
 
+def bench_elastic():
+    """Elastic-restore rung (VESCALE_BENCH=elastic): restore-and-reshard
+    wall time onto a DIFFERENT mesh vs a same-shape restore of the same
+    checkpoint — the price of resuming after a capacity change relative to
+    an ordinary resume.  One checkpoint (sharded params + ZeRO optimizer
+    state) is written from an N-device dp mesh, then loaded back (a)
+    same-shape and (b) onto an N/2-device mesh via recomputed
+    ``state_template`` shardings — (b) is the chunk-box reshard path the
+    writer-mesh meta routes a world change to (VSC130)."""
+    import tempfile
+
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from vescale_tpu import checkpoint as ckpt
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.parallel.optimizer import DistributedOptimizer
+
+    devices = jax.devices()
+    n = len(devices)
+    half = max(1, n // 2)
+    on_tpu = devices[0].platform == "tpu"
+    rows = 1024 if not on_tpu else 8192
+    cols = 256
+
+    def world(ndev):
+        mesh = DeviceMesh(("dp",), (ndev,), devices=devices[:ndev])
+        sh = NamedSharding(mesh.jax_mesh, P("dp", None))
+        params = {
+            f"w{i}": jax.device_put(
+                np.random.default_rng(i).normal(size=(rows, cols)).astype(np.float32), sh
+            )
+            for i in range(4)
+        }
+        pspecs = {f"w{i}": P("dp", None) for i in range(4)}
+        dopt = DistributedOptimizer(optax.adamw(1e-3), mesh, pspecs)
+        return params, dopt
+
+    params, dopt = world(n)
+    state = dopt.init(params)
+    root = tempfile.mkdtemp(prefix="bench_elastic_")
+    path = f"{root}/ck"
+    ckpt.save(path, {"model": params, "optimizer": state})
+
+    def timed_load(template):
+        t0 = time.perf_counter()
+        ckpt.load(path, template)
+        return time.perf_counter() - t0
+
+    # same-shape template (the ordinary resume)
+    same_tmpl = {"model": params, "optimizer": dopt.state_template(params)}
+    # cross-shape template: half the devices, recomputed ZeRO shardings
+    params_h, dopt_h = world(half)
+    cross_tmpl = {"model": params_h, "optimizer": dopt_h.state_template(params_h)}
+
+    same = min(timed_load(same_tmpl) for _ in range(3))
+    cross = min(timed_load(cross_tmpl) for _ in range(3))
+    degenerate = half == n  # 1-device host: no smaller world to reshard onto
+    if not degenerate:
+        assert ckpt.LAST_LOAD_STATS["elastic"] == 1  # the cross load resharded
+    bytes_state = sum(
+        int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+        for l in jax.tree_util.tree_leaves(state)
+        if hasattr(l, "shape")
+    ) + sum(int(np.prod(l.shape)) * 4 for l in jax.tree_util.tree_leaves(params))
+    print(json.dumps({
+        "metric": "elastic_reshard_ratio" if on_tpu else "elastic_reshard_ratio_cpu",
+        # null on a 1-device host: both loads are the same dp=1 mesh, so a
+        # "ratio" would record pure timing noise as a reshard cost
+        "value": None if degenerate else (round(cross / same, 4) if same > 0 else None),
+        "unit": "x_same_shape_restore",
+        "same_shape_s": round(same, 4),
+        "reshard_s": None if degenerate else round(cross, 4),
+        "mesh": f"dp={n}->dp={half}" + (" (degenerate: no reshard ran)" if degenerate else ""),
+        "state_mb": round(bytes_state / 2**20, 2),
+    }))
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -735,6 +814,8 @@ def _dispatch():
         bench_resilience()
     elif which == "watchdog":
         bench_watchdog()
+    elif which == "elastic":
+        bench_elastic()
     elif which == "redistribute":
         # multi-hop planner battery (VESCALE_BENCH=redistribute): plan
         # length, bytes moved and retrace count per representative

@@ -1,0 +1,306 @@
+"""Elastic world-size smoke: a committed run resumes on a DIFFERENT
+process count/mesh, bit-identical to an uninterrupted fixed-size run.
+
+The end-to-end proof of the elastic-restore stack (mirrors
+watchdog_smoke.py's supervisor framing, on the 2-process gloo rig of
+tests/test_multiprocess.py):
+
+  golden  2 processes x 4 devices, 10 steps, no faults — the bit-exactness
+          reference (per-step losses + full final state).
+  2 -> 1  same run with VESCALE_FAULTSIM="resize:step=5,rank=0": rank 0's
+          simulated capacity change is OR-agreed over the control exchange,
+          both ranks drain + emergency-save step 4 and exit "resized";
+          a SINGLE process (half the devices, double the per-rank batch)
+          then auto-resumes and finishes.  Losses for steps 5..9 must be
+          BIT-IDENTICAL to golden, and the final checkpoint's fully
+          assembled state (params AND optimizer moments) must match
+          golden's byte-for-byte.
+  1 -> 2  the reverse: train on 1 process, resize at step 5, resume on 2.
+
+What that exercises, layer by layer: the meta.json writer block routing
+the world change to reshard-on-load (VSC130) instead of an opaque
+failure; optimizer-state chunk-box reshard onto recomputed shardings;
+the elastic loader's rank-invariant global cursor re-splitting the sample
+position (no sample skipped or replayed); `latest_common_step` across the
+join; and the faultsim `resize` kind driving it all deterministically.
+
+The training step is built so its trajectory is bitwise world-invariant
+by construction: batch statistics are reduced as INTEGER token sums
+(associative — any rank split sums identically), the scalar update they
+derive feeds only ELEMENTWISE jax ops on the sharded params/moments
+(per-element IEEE arithmetic, no cross-element reductions), and the loss
+is host float64 math on the integer sum plus a replicated scalar param.
+Any deviation is therefore a real restore bug, not reduction-order noise.
+
+Exit 0 on success.  Wired into tier-1 via tests/test_elastic.py and into
+scripts/run_test.sh.
+"""
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TOTAL = 10
+SAVE_EVERY = 3  # commits at 2, 5, 8, 9
+RESIZE_STEP = 5  # -> last completed step 4, emergency save at 4
+GLOBAL_BATCH = 8
+SEQ = 16
+SEED = 11
+
+
+# --------------------------------------------------------------------- child
+def child(root: str, tok_path: str, world: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import vescale_tpu.distributed as vdist
+
+    if world > 1:
+        vdist.initialize()
+    me = jax.process_index()
+    assert jax.process_count() == world
+
+    import jax.numpy as jnp  # noqa: E402
+    from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+    from vescale_tpu import checkpoint as ckpt  # noqa: E402
+    from vescale_tpu.checkpoint import CheckpointManager  # noqa: E402
+    from vescale_tpu.data import TokenDataLoader  # noqa: E402
+    from vescale_tpu.distributed import allgather_ints  # noqa: E402
+    from vescale_tpu.mesh import DeviceMesh  # noqa: E402
+    from vescale_tpu.resilience import run_resilient  # noqa: E402
+
+    ndev = len(jax.devices())
+    mesh = DeviceMesh(("dp",), (ndev,))
+    sh = NamedSharding(mesh.jax_mesh, P("dp"))
+    mk = jax.make_array_from_callback
+
+    w0 = (np.arange(64, dtype=np.float32) / 64.0) - 0.5
+    z = np.zeros(64, np.float32)
+    params0 = {"w": mk(w0.shape, sh, lambda i: w0[i]), "b": np.float64(0.25)}
+    opt0 = {
+        "mu": mk(z.shape, sh, lambda i: z[i]),
+        "nu": mk(z.shape, sh, lambda i: z[i]),
+        "count": np.int64(0),
+    }
+
+    @jax.jit
+    def _upd(w, mu, nu, g):
+        # ELEMENTWISE only — bitwise invariant to the mesh split
+        mu2 = 0.9 * mu + 0.1 * g * w
+        nu2 = 0.99 * nu + 0.01 * g * g * w * w
+        w2 = w - 0.05 * (g * w + 0.001 * mu2)
+        return w2, mu2, nu2
+
+    def step_fn(params, opt, batch, step_key=None):
+        # exact world-invariant batch statistic: integer token sum over the
+        # GLOBAL batch (int addition is associative; the elastic loader
+        # serves the same global rows under any split)
+        local = int(np.asarray(batch["input"], np.int64).sum())
+        rows = allgather_ints([local], tag="elastic_smoke_sum")
+        s = int(rows.sum())
+        g = (float(s % 1000003) / 1000003.0) - 0.5  # exact float64 from int
+        w2, mu2, nu2 = _upd(params["w"], opt["mu"], opt["nu"], np.float32(g))
+        b2 = np.float64(params["b"]) - np.float64(0.05) * np.float64(g)
+        loss = float(b2 * b2) + g  # host float64 math: bit-exact
+        return (
+            {"w": w2, "b": b2},
+            {"mu": mu2, "nu": nu2, "count": np.int64(int(opt["count"]) + 1)},
+            loss,
+        )
+
+    loader = TokenDataLoader(
+        tok_path,
+        batch=GLOBAL_BATCH // world,
+        seq_len=SEQ,
+        seed=SEED,
+        dp_rank=me,
+        dp_world=world,
+        elastic=True,
+    )
+    mgr = CheckpointManager(root, keep=4)
+    res = run_resilient(
+        step_fn=step_fn,
+        params=params0,
+        opt_state=opt0,
+        manager=mgr,
+        loader=loader,
+        total_steps=TOTAL,
+        save_every=SAVE_EVERY,
+        async_save=False,  # deterministic commits (watchdog_smoke rationale)
+        rng_seed=3,
+        install_signal_handlers=False,
+        barrier_timeout_s=60.0 if world > 1 else None,
+    )
+    loader.close()
+    if os.environ.get("EXPECT_ELASTIC") == "1":
+        # the startup restore was the only load: its stats must say the
+        # writer world differed (the reshard-on-load actually happened)
+        assert ckpt.LAST_LOAD_STATS.get("elastic") == 1, ckpt.LAST_LOAD_STATS
+        print("elastic_restore=1")
+    if me == 0:
+        for s in sorted(res.losses):
+            print(f"loss step={s} {res.losses[s]:.17g}")
+    print(f"status={res.status} step={res.step}")
+    print(f"OK proc {me}")
+
+
+# -------------------------------------------------------------------- driver
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def run_world(root: str, tok: str, world: int, extra_env=None, timeout=300):
+    """Spawn `world` child processes (4 virtual CPU devices each) and
+    return their (returncode, output) pairs."""
+    port = _free_port()
+    procs = []
+    for pid in range(world):
+        env = dict(os.environ)
+        for k in ("VESCALE_FAULTSIM", "EXPECT_ELASTIC", "VESCALE_COORDINATOR",
+                  "VESCALE_NUM_PROCESSES", "VESCALE_PROCESS_ID"):
+            env.pop(k, None)
+        env.update(JAX_PLATFORMS="cpu", PYTHONPATH=f"{REPO}:{env.get('PYTHONPATH', '')}")
+        if world > 1:
+            env.update(
+                VESCALE_COORDINATOR=f"localhost:{port}",
+                VESCALE_NUM_PROCESSES=str(world),
+                VESCALE_PROCESS_ID=str(pid),
+            )
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=4"])
+        if extra_env:
+            env.update({k: str(v) for k, v in extra_env.items()})
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child", root, tok, str(world)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    return [(p.returncode, out) for p, out in zip(procs, outs)]
+
+
+def losses_of(out: str):
+    return [l for l in out.splitlines() if l.startswith("loss step=")]
+
+
+def assemble_final(root: str):
+    """Fully assemble the final checkpoint's state on the host (np
+    templates force full logical assembly) — the cross-run byte-for-byte
+    comparison surface, INDEPENDENT of the mesh that wrote it."""
+    import numpy as np
+
+    from vescale_tpu import checkpoint as ckpt
+
+    tmpl = {
+        "model": {"w": np.zeros(64, np.float32), "b": np.zeros((), np.float64)},
+        "optimizer": {
+            "mu": np.zeros(64, np.float32),
+            "nu": np.zeros(64, np.float32),
+            "count": np.zeros((), np.int64),
+        },
+    }
+    path = os.path.join(root, f"step_{TOTAL - 1:010d}")
+    out = ckpt.load(path, tmpl)
+    return {
+        k: {kk: np.asarray(vv).tobytes() for kk, vv in v.items()}
+        for k, v in out.items()
+    }
+
+
+def check_run(results, label: str):
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"{label}: proc {pid} rc={rc}\n{out[-4000:]}"
+        assert f"OK proc {pid}" in out, f"{label}: proc {pid}\n{out[-2000:]}"
+
+
+def main() -> None:
+    import numpy as np
+
+    work = tempfile.mkdtemp(prefix="elastic_smoke_")
+    try:
+        tok = os.path.join(work, "train.bin")
+        np.random.default_rng(0).integers(0, 256, 200_000).astype(np.uint16).tofile(tok)
+        # build the native loader once, before any concurrent child tries
+        sys.path.insert(0, REPO)
+        from vescale_tpu.data.loader import build_native
+
+        build_native()
+
+        t0 = time.monotonic()
+        # ---- golden: uninterrupted 2-process run
+        golden = run_world(os.path.join(work, "golden"), tok, world=2)
+        check_run(golden, "golden")
+        gl = losses_of(golden[0][1])
+        assert len(gl) == TOTAL, gl
+        assert "status=completed" in golden[0][1]
+        golden_state = assemble_final(os.path.join(work, "golden"))
+
+        # ---- leg A: 2 -> 1
+        rootA = os.path.join(work, "a")
+        resized = run_world(rootA, tok, world=2,
+                            extra_env={"VESCALE_FAULTSIM": f"resize:step={RESIZE_STEP},rank=0"})
+        check_run(resized, "A/resize")
+        out0 = resized[0][1]
+        assert f"status=resized step={RESIZE_STEP - 1}" in out0, out0[-2000:]
+        assert losses_of(out0) == gl[:RESIZE_STEP], "pre-resize losses diverged"
+        resumed = run_world(rootA, tok, world=1, extra_env={"EXPECT_ELASTIC": "1"})
+        check_run(resumed, "A/resume")
+        r_out = resumed[0][1]
+        assert "elastic_restore=1" in r_out
+        assert losses_of(r_out) == gl[RESIZE_STEP:], (
+            "2->1 resume diverged:\n" + "\n".join(losses_of(r_out))
+            + "\n-- golden --\n" + "\n".join(gl[RESIZE_STEP:])
+        )
+        assert assemble_final(rootA) == golden_state, "2->1 final state differs"
+
+        # ---- leg B: 1 -> 2
+        rootB = os.path.join(work, "b")
+        resizedB = run_world(rootB, tok, world=1,
+                             extra_env={"VESCALE_FAULTSIM": f"resize:step={RESIZE_STEP}"})
+        check_run(resizedB, "B/resize")
+        outB = resizedB[0][1]
+        assert f"status=resized step={RESIZE_STEP - 1}" in outB, outB[-2000:]
+        assert losses_of(outB) == gl[:RESIZE_STEP], "1-proc prefix losses diverged"
+        resumedB = run_world(rootB, tok, world=2, extra_env={"EXPECT_ELASTIC": "1"})
+        check_run(resumedB, "B/resume")
+        rB = resumedB[0][1]
+        assert "elastic_restore=1" in rB
+        assert losses_of(rB) == gl[RESIZE_STEP:], (
+            "1->2 resume diverged:\n" + "\n".join(losses_of(rB))
+            + "\n-- golden --\n" + "\n".join(gl[RESIZE_STEP:])
+        )
+        assert assemble_final(rootB) == golden_state, "1->2 final state differs"
+
+        print(
+            f"ELASTIC SMOKE OK: 2->1 and 1->2 resumes bit-identical to golden "
+            f"(losses, params AND optimizer moments) in {time.monotonic() - t0:.1f}s"
+        )
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[2], sys.argv[3], int(sys.argv[4]))
+    else:
+        main()

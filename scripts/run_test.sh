@@ -8,6 +8,8 @@ failed=0
 echo "=== vescale-lint + shardcheck smoke (static analysis gate)"
 python -m vescale_tpu.analysis --strict lint || failed=1
 python scripts/shardcheck_smoke.py || failed=1
+echo "=== elastic world-size smoke (2->1 and 1->2 resume, bit-identical)"
+python scripts/elastic_smoke.py || failed=1
 for f in tests/test_*.py; do
   echo "=== $f"
   python -m pytest "$f" -q || failed=1

@@ -1,0 +1,515 @@
+"""Elastic world size (ISSUE 7): resume a committed run on a different
+mesh, bit-identical.
+
+Unit coverage for every layer of the elastic-restore stack — the
+checkpoint writer-mesh block + VSC13x preflight, optimizer-state reshard
+onto recomputed (``state_template``) shardings, RaggedShard re-bucketing
+of flattened FSDP buffers (including coprime shard counts), the data
+loader's rank-invariant global cursor (2->1, 1->2, backward seek), the
+join-tolerant ``latest_common_step``, and the faultsim ``resize`` kind —
+plus the tier-1 wiring of scripts/elastic_smoke.py (the 2-process gloo
+proof: losses AND optimizer moments bit-identical across 2->1 and 1->2).
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import vescale_tpu as vt
+import vescale_tpu.checkpoint as ckpt
+from vescale_tpu.checkpoint import CheckpointManager, ElasticMismatchError
+from vescale_tpu.checkpoint.reshard import Box, fill_box_from_chunks
+from vescale_tpu.mesh import DeviceMesh
+from vescale_tpu.parallel.fsdp import FSDPParamBuffer
+from vescale_tpu.parallel.optimizer import DistributedOptimizer
+from vescale_tpu.placements import RaggedShard
+
+REPO = str(pathlib.Path(__file__).resolve().parent.parent)
+
+
+@pytest.fixture(scope="module")
+def token_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("tok") / "train.bin"
+    np.random.default_rng(0).integers(0, 256, 100_000).astype(np.uint16).tofile(str(p))
+    return str(p)
+
+
+def _loader(token_file, **kw):
+    from vescale_tpu.data import TokenDataLoader
+
+    args = dict(batch=2, seq_len=8, seed=5, elastic=True)
+    args.update(kw)
+    return TokenDataLoader(token_file, **args)
+
+
+# ---------------------------------------------------------------- loader
+def test_elastic_stream_invariant_to_world_split(token_file):
+    """The global token stream must be a pure function of (seed, global
+    row): any (dp_world, per-rank batch) factorization of the same global
+    batch serves identical global rows."""
+    l0 = _loader(token_file, dp_rank=0, dp_world=2)
+    l1 = _loader(token_file, dp_rank=1, dp_world=2)
+    g = _loader(token_file, batch=4, dp_world=1)
+    try:
+        for i in range(3):
+            b0, b1, bg = l0.next(), l1.next(), g.next()
+            assert np.array_equal(
+                np.concatenate([b0["input"], b1["input"]]), bg["input"]
+            ), f"global batch {i} differs across splits"
+            assert np.array_equal(
+                np.concatenate([b0["target"], b1["target"]]), bg["target"]
+            )
+    finally:
+        for l in (l0, l1, g):
+            l.close()
+
+
+def test_elastic_state_resplit_2_to_1(token_file):
+    l0 = _loader(token_file, dp_rank=0, dp_world=2)
+    ref = _loader(token_file, batch=4, dp_world=1)
+    try:
+        for _ in range(3):
+            l0.next()
+            ref.next()
+        st = l0.state()
+        assert st["elastic"] == 1
+        assert st["samples_served"] == 3 * 4 and st["global_batch"] == 4
+        g = _loader(token_file, batch=4, dp_world=1)
+        try:
+            g.load_state(st)  # different split: re-derived from the cursor
+            assert g.batches_served == 3
+            # no sample skipped or replayed: next batch == uninterrupted next
+            assert np.array_equal(g.next()["input"], ref.next()["input"])
+        finally:
+            g.close()
+    finally:
+        l0.close()
+        ref.close()
+
+
+def test_elastic_state_resplit_1_to_2_and_backward(token_file):
+    g = _loader(token_file, batch=4, dp_world=1)
+    try:
+        for _ in range(4):
+            g.next()
+        st = g.state()
+        l1 = _loader(token_file, dp_rank=1, dp_world=2)
+        try:
+            for _ in range(6):
+                l1.next()
+            l1.load_state(st)  # backward seek (6 -> 4) + re-split
+            assert l1.batches_served == 4
+            ref = _loader(token_file, batch=4, dp_world=1)
+            try:
+                ref.load_state(st)
+                # rank 1 serves the second half of global batch 4
+                assert np.array_equal(l1.next()["input"], ref.next()["input"][2:])
+            finally:
+                ref.close()
+        finally:
+            l1.close()
+    finally:
+        g.close()
+
+
+def test_elastic_resplit_requires_same_global_batch(token_file):
+    l = _loader(token_file, dp_rank=0, dp_world=2)
+    bad = _loader(token_file, batch=3, dp_world=1)  # global batch 4 -> 3
+    try:
+        l.next()
+        with pytest.raises(ValueError, match="VSC133"):
+            bad.load_state(l.state())
+    finally:
+        l.close()
+        bad.close()
+
+
+def test_nonelastic_identity_checks_unchanged(token_file):
+    a = _loader(token_file, dp_rank=0, dp_world=2, elastic=False)
+    b = _loader(token_file, batch=4, dp_world=1, elastic=False)
+    try:
+        a.next()
+        with pytest.raises(ValueError, match="elastic=True"):
+            b.load_state(a.state())
+        # same-coords round trip still exact
+        st = a.state()
+        a.next()
+        a.load_state(st)
+        assert a.batches_served == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_elastic_mode_is_an_identity_coord(token_file):
+    """A state crossing the elastic/non-elastic boundary at IDENTICAL dp
+    coords must be rejected: the two modes key samples differently, so
+    accepting it would silently switch the stream (review finding)."""
+    ne = _loader(token_file, dp_rank=0, dp_world=2, elastic=False)
+    e = _loader(token_file, dp_rank=0, dp_world=2)
+    try:
+        ne.next()
+        with pytest.raises(ValueError, match="elastic"):
+            e.load_state(ne.state())
+        e.next()
+        with pytest.raises(ValueError, match="elastic"):
+            ne.load_state(e.state())
+    finally:
+        ne.close()
+        e.close()
+
+
+def test_host_template_load_is_not_elastic(tmp_path, monkeypatch):
+    """Plain-numpy (full-assembly) templates carry no mesh: they must not
+    count as elastic restores nor be refused by the opt-out (review
+    finding) — that is the standard inspection path."""
+    mesh = DeviceMesh(("dp",), (4,))
+    vals = np.arange(32, dtype=np.float32).reshape(8, 4)
+    ckpt.save(str(tmp_path / "c"), {"model": _sharded_params(mesh, vals)})
+    monkeypatch.setenv("VESCALE_ELASTIC_RESTORE", "0")
+    out = ckpt.load(str(tmp_path / "c"), {"model": {"w": np.zeros((8, 4), np.float32)}})
+    assert ckpt.LAST_LOAD_STATS["elastic"] == 0
+    assert np.array_equal(out["model"]["w"], vals)
+
+
+def test_elastic_and_legacy_streams_differ(token_file):
+    """The elastic keying is a DIFFERENT stream from the historical
+    rank-partitioned one — the default must stay off for bit-compat."""
+    e = _loader(token_file, dp_rank=0, dp_world=2)
+    n = _loader(token_file, dp_rank=0, dp_world=2, elastic=False)
+    try:
+        assert not np.array_equal(e.next()["input"], n.next()["input"])
+    finally:
+        e.close()
+        n.close()
+
+
+# ----------------------------------------------------- reshard chunk math
+def test_fill_box_coprime_shard_counts():
+    """3 saved shards -> 2 readers (coprime): every target range straddles
+    a saved-chunk boundary, covering the multi-source fill path."""
+    x = np.arange(30, dtype=np.float32)
+    saved_chunks = {}
+    saved = []
+    for i, (off, size) in enumerate([(0, 10), (10, 10), (20, 10)]):
+        saved_chunks[f"c{i}"] = x[off:off + size]
+        saved.append((Box((off,), (size,), flat=True), f"c{i}"))
+    for off, size in [(0, 15), (15, 15)]:
+        out = fill_box_from_chunks(
+            Box((off,), (size,), flat=True), (30,), np.float32, saved,
+            lambda f: saved_chunks[f],
+        )
+        assert np.array_equal(out, x[off:off + size])
+    # dense saves -> coprime flat readers (mixed-space path)
+    dense = [(Box((r * 10,), (10,)), f"c{r}") for r in range(3)]
+    dense_chunks = {f"c{r}": x[r * 10:(r + 1) * 10] for r in range(3)}
+    out = fill_box_from_chunks(
+        Box((7,), (16,), flat=True), (30,), np.float32, dense, lambda f: dense_chunks[f]
+    )
+    assert np.array_equal(out, x[7:23])
+
+
+def test_ragged_rebucket_coprime_worlds(tmp_path):
+    """FSDP flat buffers: saved under 3-rank bucketing, restored into a
+    2-rank FSDPParamBuffer's re-balanced units via buffer_templates."""
+    mesh3 = DeviceMesh(("dp",), (3,))
+    mesh2 = DeviceMesh(("dp",), (2,))
+    x = np.arange(24, dtype=np.float32)
+    d = vt.distribute_tensor(x, mesh3, [RaggedShard((0,), (10, 6, 8))])
+    ckpt.save(str(tmp_path / "rg"), {"m": {"buf": d}})
+    buf2 = FSDPParamBuffer(
+        {
+            "a": jax.ShapeDtypeStruct((6,), np.float32),
+            "b": jax.ShapeDtypeStruct((10,), np.float32),
+            "c": jax.ShapeDtypeStruct((8,), np.float32),
+        },
+        mesh2,
+        dim="dp",
+    )
+    tmpl = buf2.buffer_templates()
+    assert set(tmpl) == {"float32"}
+    assert tmpl["float32"].spec.placements[0].local_units != (10, 6, 8)
+    out = ckpt.load(str(tmp_path / "rg"), {"m": {"buf": tmpl["float32"]}})
+    assert np.array_equal(np.asarray(out["m"]["buf"].full_tensor()), x)
+    assert ckpt.LAST_LOAD_STATS["elastic"] == 1  # dp=3 -> dp=2 IS a mesh change
+
+
+# ----------------------------------------------- writer meta + preflight
+def _sharded_params(mesh, vals):
+    return {"w": jax.device_put(vals, NamedSharding(mesh.jax_mesh, P("dp", None)))}
+
+
+def test_writer_meta_recorded_and_readable(tmp_path):
+    mesh = DeviceMesh(("dp",), (4,))
+    p = _sharded_params(mesh, np.zeros((8, 4), np.float32))
+    ckpt.save(str(tmp_path / "c"), {"model": p})
+    meta = json.load(open(tmp_path / "c" / "meta.json"))
+    assert meta["writer"]["device_count"] == len(jax.devices())
+    assert meta["writer"]["process_count"] == 1
+    assert meta["writer"]["meshes"] == ["dp=4"]
+    assert ckpt.read_writer_meta(str(tmp_path / "c")) == meta["writer"]
+    mgr = CheckpointManager(str(tmp_path / "m"), keep=2)
+    mgr.save(0, {"model": p})
+    assert mgr.writer_meta(0)["meshes"] == ["dp=4"]
+    assert mgr.writer_meta(99) is None
+
+
+def test_cross_mesh_load_counts_elastic(tmp_path):
+    vals = np.arange(32, dtype=np.float32).reshape(8, 4)
+    ckpt.save(str(tmp_path / "c"), {"model": _sharded_params(DeviceMesh(("dp",), (4,)), vals)})
+    out = ckpt.load(
+        str(tmp_path / "c"),
+        {"model": _sharded_params(DeviceMesh(("dp",), (8,)), np.zeros_like(vals))},
+    )
+    assert ckpt.LAST_LOAD_STATS["elastic"] == 1
+    assert np.array_equal(np.asarray(jax.device_get(out["model"]["w"])), vals)
+    # same-mesh reload: not elastic
+    ckpt.load(
+        str(tmp_path / "c"),
+        {"model": _sharded_params(DeviceMesh(("dp",), (4,)), np.zeros_like(vals))},
+    )
+    assert ckpt.LAST_LOAD_STATS["elastic"] == 0
+
+
+def test_shape_mismatch_is_coded_and_preread(tmp_path, monkeypatch):
+    """VSC131 must name the key and both shapes and fire BEFORE any chunk
+    byte is read (only meta.json may be touched)."""
+    mesh = DeviceMesh(("dp",), (4,))
+    ckpt.save(
+        str(tmp_path / "c"), {"model": _sharded_params(mesh, np.zeros((8, 4), np.float32))}
+    )
+    reads = []
+    orig = ckpt.FileSystemStorage.read_bytes
+
+    def counting(self, name):
+        reads.append(name)
+        return orig(self, name)
+
+    monkeypatch.setattr(ckpt.FileSystemStorage, "read_bytes", counting)
+    with pytest.raises(ElasticMismatchError) as ei:
+        ckpt.load(
+            str(tmp_path / "c"),
+            {"model": _sharded_params(mesh, np.zeros((16, 2), np.float32))},
+        )
+    assert "VSC131" in str(ei.value) and "model/w" in str(ei.value)
+    assert ei.value.report.by_code("VSC131")
+    assert all(r == "meta.json" for r in reads), reads
+    # ElasticMismatchError IS a ValueError: legacy callers keep working
+    assert isinstance(ei.value, ValueError)
+
+
+def test_elastic_restore_opt_out(tmp_path, monkeypatch):
+    vals = np.zeros((8, 4), np.float32)
+    ckpt.save(str(tmp_path / "c"), {"model": _sharded_params(DeviceMesh(("dp",), (4,)), vals)})
+    monkeypatch.setenv("VESCALE_ELASTIC_RESTORE", "0")
+    with pytest.raises(ElasticMismatchError, match="VSC132"):
+        ckpt.load(
+            str(tmp_path / "c"),
+            {"model": _sharded_params(DeviceMesh(("dp",), (8,)), vals)},
+        )
+    # same-world loads are unaffected by the opt-out
+    ckpt.load(str(tmp_path / "c"), {"model": _sharded_params(DeviceMesh(("dp",), (4,)), vals)})
+
+
+def test_vsc13x_codes_registered():
+    from vescale_tpu.analysis.findings import CODES, Severity
+
+    assert CODES["VSC130"].severity == Severity.INFO
+    for c in ("VSC131", "VSC132", "VSC133"):
+        assert CODES[c].severity == Severity.ERROR
+
+
+# ------------------------------------------- optimizer-state reshard
+def test_state_template_matches_init_and_loads_cross_world(tmp_path):
+    vals = np.arange(64, dtype=np.float32).reshape(16, 4)
+    mesh4, mesh8 = DeviceMesh(("dp",), (4,)), DeviceMesh(("dp",), (8,))
+    p4 = _sharded_params(mesh4, vals)
+    d4 = DistributedOptimizer(optax.adamw(1e-3), mesh4, {"w": P("dp", None)})
+    s4 = d4.init(p4)
+    # seed the moments with recognizable content
+    inner = list(s4["inner"])
+    inner[0] = inner[0]._replace(
+        mu={"w": jax.device_put(vals * 0.5, inner[0].mu["w"].sharding)},
+        nu={"w": jax.device_put(vals * 0.25, inner[0].nu["w"].sharding)},
+    )
+    s4["inner"] = tuple(inner)
+    ckpt.save(str(tmp_path / "c"), {"optimizer": s4})
+
+    p8 = _sharded_params(mesh8, vals)
+    d8 = DistributedOptimizer(optax.adamw(1e-3), mesh8, {"w": P("dp", None)})
+    tmpl = d8.state_template(p8)
+    # template mirrors init()'s tree: same structure, shapes, dtypes
+    concrete = jax.eval_shape(d8.init, p8)
+    assert jax.tree_util.tree_structure(tmpl) == jax.tree_util.tree_structure(concrete)
+    t_mu = tmpl["inner"][0].mu["w"]
+    assert isinstance(t_mu, jax.ShapeDtypeStruct)
+    # the recomputed range map: dp=8 shardings, not the writer's dp=4
+    assert t_mu.sharding.mesh.devices.size == 8
+
+    out = ckpt.load(str(tmp_path / "c"), {"optimizer": tmpl})
+    assert ckpt.LAST_LOAD_STATS["elastic"] == 1
+    got = out["optimizer"]["inner"][0]
+    assert np.array_equal(np.asarray(jax.device_get(got.mu["w"])), vals * 0.5)
+    assert np.array_equal(np.asarray(jax.device_get(got.nu["w"])), vals * 0.25)
+    # every new rank's shard holds exactly its recomputed range
+    assert got.mu["w"].sharding.is_equivalent_to(t_mu.sharding, 2)
+    # main_params roundtrip too
+    assert np.array_equal(
+        np.asarray(jax.device_get(out["optimizer"]["main_params"]["w"])), vals
+    )
+
+
+def test_state_template_unsharded_optimizer():
+    d = DistributedOptimizer(optax.adamw(1e-3))
+    p = {"w": jnp.ones((4, 4), jnp.float32)}
+    tmpl = d.state_template(p)
+    leaf = tmpl["main_params"]["w"]
+    assert isinstance(leaf, jax.ShapeDtypeStruct) and leaf.shape == (4, 4)
+
+
+# ------------------------------------------------- join-aware recovery
+def test_latest_common_step_joining_rank_abstains():
+    rows = np.array([[-1, -1, -1], [2, 5, 8], [-1, 5, 8]])
+    assert CheckpointManager._common_from_rows(rows) == 8
+    # all-empty: nothing restorable anywhere
+    assert CheckpointManager._common_from_rows(np.array([[-1], [-1]])) is None
+    # populated ranks still intersect strictly
+    assert CheckpointManager._common_from_rows(np.array([[2, 5], [3, 5]])) == 5
+    assert CheckpointManager._common_from_rows(np.array([[2], [3]])) is None
+
+
+# ----------------------------------------------------- resize fault kind
+def test_faultsim_resize_parses_and_run_returns_resized(tmp_path):
+    from vescale_tpu import telemetry
+    from vescale_tpu.resilience import faultsim, run_resilient
+
+    f = faultsim.parse_schedule("resize:step=5")[0]
+    assert f.kind == "resize" and f.at_step == 5
+
+    def step_fn(p, o, b, k=None):
+        return {"w": p["w"] + b}, {"n": o["n"] + 1}, float(p["w"].sum())
+
+    telemetry.init()
+    faultsim.arm(faultsim.parse_schedule("resize:step=5"))
+    try:
+        mgr = CheckpointManager(str(tmp_path / "c"), keep=3)
+        res = run_resilient(
+            step_fn=step_fn,
+            params={"w": np.zeros(4, np.float32)},
+            opt_state={"n": 0},
+            manager=mgr,
+            batch_fn=lambda i: np.float32(i),
+            total_steps=10,
+            save_every=3,
+            rng_seed=1,
+            install_signal_handlers=False,
+        )
+        assert res.status == "resized"
+        assert res.step == 4 and res.emergency_save_step == 4
+        assert mgr.latest_step() == 4
+        snap = telemetry.get_registry().snapshot()["counters"]
+        assert snap.get("resilience_resizes_total") == 1
+        assert "resilience_preemptions_total" not in snap
+    finally:
+        faultsim.disarm()
+        telemetry.shutdown()
+    # the relaunched run resumes and completes
+    res2 = run_resilient(
+        step_fn=step_fn,
+        params={"w": np.zeros(4, np.float32)},
+        opt_state={"n": 0},
+        manager=CheckpointManager(str(tmp_path / "c"), keep=3),
+        batch_fn=lambda i: np.float32(i),
+        total_steps=10,
+        save_every=3,
+        rng_seed=1,
+        install_signal_handlers=False,
+    )
+    assert res2.status == "completed" and min(res2.losses) == 5
+
+
+def test_elastic_restore_counter_in_resilience_block(tmp_path):
+    """The VSC130 reshard-on-load counters fold into the resilience:
+    dashboard block (prefix contract of the exporters)."""
+    from vescale_tpu import telemetry
+
+    vals = np.zeros((8, 4), np.float32)
+    ckpt.save(str(tmp_path / "c"), {"model": _sharded_params(DeviceMesh(("dp",), (4,)), vals)})
+    telemetry.init()
+    try:
+        ckpt.load(
+            str(tmp_path / "c"),
+            {"model": _sharded_params(DeviceMesh(("dp",), (8,)), vals)},
+        )
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"].get("resilience_elastic_restores_total") == 1
+        assert "resilience_reshard_seconds" in snap["histograms"]
+        dash = telemetry.dashboard()
+        assert "resilience:" in dash and "resilience_elastic_restores_total" in dash
+    finally:
+        telemetry.shutdown()
+
+
+def test_run_resilient_refuses_cross_world_when_disabled(tmp_path, monkeypatch):
+    """With VESCALE_ELASTIC_RESTORE=0 a world change must refuse loudly
+    (coded, no quarantine) instead of sidelining good checkpoints."""
+    from vescale_tpu.resilience import run_resilient
+
+    vals = np.arange(32, dtype=np.float32).reshape(8, 4)
+    mesh4 = DeviceMesh(("dp",), (4,))
+
+    def step4(p, o, b, k=None):
+        return p, o, 1.0
+
+    mgr = CheckpointManager(str(tmp_path / "c"), keep=3)
+    run_resilient(
+        step_fn=step4,
+        params=_sharded_params(mesh4, vals),
+        opt_state={"n": 0},
+        manager=mgr,
+        batch_fn=lambda i: None,
+        total_steps=3,
+        save_every=2,
+        install_signal_handlers=False,
+    )
+    assert mgr.latest_step() == 2
+    monkeypatch.setenv("VESCALE_ELASTIC_RESTORE", "0")
+    with pytest.raises(RuntimeError, match="refusing to quarantine"):
+        run_resilient(
+            step_fn=step4,
+            params=_sharded_params(DeviceMesh(("dp",), (8,)), vals),
+            opt_state={"n": 0},
+            manager=CheckpointManager(str(tmp_path / "c"), keep=3),
+            batch_fn=lambda i: None,
+            total_steps=4,
+            save_every=2,
+            install_signal_handlers=False,
+        )
+    # nothing was quarantined: the checkpoint is still the newest committed
+    assert CheckpointManager(str(tmp_path / "c"), keep=3).latest_step() == 2
+
+
+# ------------------------------------------------------------ smoke wiring
+def test_elastic_smoke_script():
+    """tier-1 wiring of scripts/elastic_smoke.py: train on 2 procs, resize,
+    resume on 1 (and 1->2) — losses and optimizer moments bit-identical to
+    an uninterrupted golden run (the ISSUE 7 acceptance scenario)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "elastic_smoke.py")],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, f"stdout:\n{out.stdout[-3000:]}\nstderr:\n{out.stderr[-3000:]}"
+    assert "ELASTIC SMOKE OK" in out.stdout

@@ -265,6 +265,12 @@ register("VESCALE_WATCHDOG_EXIT_CODE", "int", 17,
 register("VESCALE_WATCHDOG_DIR", "str", None,
          "Directory for watchdog hang dumps when telemetry has no out_dir; unset disables dumping.")
 
+# --- elastic world size ----------------------------------------------
+register("VESCALE_ELASTIC_LOADER", "bool", False,
+         "Sample the token stream by GLOBAL row index so it is invariant to the (dp_world, per-rank batch) split — required on both runs for an elastic world-size resume (docs/resilience.md).")
+register("VESCALE_ELASTIC_RESTORE", "bool", True,
+         "Allow restoring a checkpoint written by a different mesh/world size (reshard-on-load, VSC130); `0` refuses cross-world restores with a VSC132 finding.")
+
 # --- bench harness ---------------------------------------------------
 register("VESCALE_BENCH", "str", None,
          "Which bench rung to run (e.g. `serve`, `redistribute`, `memtrack`, `watchdog`); unset = default MFU line.")

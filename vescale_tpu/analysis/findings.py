@@ -16,6 +16,9 @@ Code blocks:
           misuse, donation misses, divergent control flow, stage misfits)
   VSC12x  redistribute planner decline reasons (shared with
           ``redistribute_plan.decline_reason`` / ``_warn_fallback``)
+  VSC13x  elastic restore — cross-world checkpoint compatibility
+          (``checkpoint.elastic`` preflight, raised BEFORE chunk bytes are
+          read; the loader's global-cursor re-split shares the block)
   VSC20x  vescale-lint — framework invariants established by PRs 1-5
 """
 
@@ -94,6 +97,15 @@ _CODE_DEFS: Tuple[Tuple[str, Severity, str], ...] = (
      "cross-mesh: destination-side dress from the bridge form failed"),
     ("VSC126", Severity.INFO,
      "planner was not consulted for this spec pair"),
+    # --- VSC13x: elastic restore (cross-world checkpoint compatibility) --
+    ("VSC130", Severity.INFO,
+     "checkpoint written by a different mesh/world size; resharding on load"),
+    ("VSC131", Severity.ERROR,
+     "checkpoint/template logical shape mismatch (not a reshardable layout change)"),
+    ("VSC132", Severity.ERROR,
+     "elastic restore disabled (VESCALE_ELASTIC_RESTORE=0) but writer mesh differs"),
+    ("VSC133", Severity.ERROR,
+     "loader position cannot be re-split: global batch shape changed across the resume"),
     # --- VSC20x: vescale-lint framework invariants -----------------------
     ("VSC201", Severity.ERROR,
      "direct os.environ read of a VESCALE_* variable outside analysis.envreg"),

@@ -53,8 +53,18 @@ from .reshard import (
     plain_load_spec,
 )
 from .storage import AsyncWriter, FileSystemStorage, MemoryStorage, Storage, bytes_to_array
+from .elastic import ElasticMismatchError
 
-__all__ = ["save", "load", "CheckpointHandle", "FileSystemStorage", "MemoryStorage", "LAST_LOAD_STATS"]
+__all__ = [
+    "save",
+    "load",
+    "CheckpointHandle",
+    "FileSystemStorage",
+    "MemoryStorage",
+    "LAST_LOAD_STATS",
+    "ElasticMismatchError",
+    "read_writer_meta",
+]
 
 _PLANNER = SavePlanner()
 _MEM_STORES: Dict[str, MemoryStorage] = {}
@@ -236,9 +246,14 @@ def _save_impl(
 ) -> Optional[CheckpointHandle]:
     from .. import telemetry as _tel
 
+    from .elastic import writer_meta
+
     storage = _storage_for(path)
     writer = AsyncWriter(storage, num_io_workers)
-    meta: Dict[str, Any] = {"arrays": {}}
+    # the writer block is the elastic-restore contract: a later load onto a
+    # DIFFERENT world compares it against its own template's world and
+    # routes to reshard (VSC130) instead of failing deep in the chunk loop
+    meta: Dict[str, Any] = {"arrays": {}, "writer": writer_meta(checkpoint_state)}
     bytes_submitted = 0  # this process's share of the data chunks
     me = jax.process_index()
     nproc = jax.process_count()
@@ -512,21 +527,44 @@ def load(
     t0 = time.perf_counter()
     with ndtimeit(CHECKPOINT_LOAD, tags={"path": path}):
         out = _load_impl(path, checkpoint_state, strict)
+    elapsed = time.perf_counter() - t0
+    if LAST_LOAD_STATS.get("elastic"):
+        # a cross-world reshard-on-load (VSC130): the elastic-restore cost,
+        # folded into the resilience: exporter block by prefix
+        _tel.count("resilience_elastic_restores_total")
+        _tel.observe("resilience_reshard_seconds", elapsed)
+        _tel.set_gauge("resilience_last_reshard_seconds", elapsed)
     if _tel.is_active():
         _tel.count("checkpoint_loads_total")
         _tel.count("checkpoint_bytes_read_total", LAST_LOAD_STATS["bytes_read"])
-        _tel.observe("checkpoint_load_seconds", time.perf_counter() - t0)
+        _tel.observe("checkpoint_load_seconds", elapsed)
     # memory attribution: freshly loaded arrays are checkpoint buffers until
     # the runtime claims them (the train-step wrapper re-tags params /
     # optimizer state on the first step)
     return _memtrack.tag_tree(out, "checkpoint_buffers")
 
 
-def _load_impl(path: str, checkpoint_state: Dict[str, Any], strict: bool) -> Dict[str, Any]:
+def read_writer_meta(path: str) -> Optional[Dict[str, Any]]:
+    """The checkpoint's ``writer`` block (process/device counts + mesh
+    descriptors recorded at save time) from ``meta.json`` alone — no chunk
+    bytes are touched.  None for pre-elastic checkpoints (no block)."""
     storage = _storage_for(path)
-    LAST_LOAD_STATS.update(bytes_read=0, files_read=0)  # reset: a failed
-    # load must not leave the previous load's stats looking current
     meta = json.loads(storage.read_bytes("meta.json").decode())
+    return meta.get("writer")
+
+
+def _load_impl(path: str, checkpoint_state: Dict[str, Any], strict: bool) -> Dict[str, Any]:
+    from .elastic import preflight
+
+    storage = _storage_for(path)
+    LAST_LOAD_STATS.update(bytes_read=0, files_read=0, elastic=0)  # reset: a
+    # failed load must not leave the previous load's stats looking current
+    meta = json.loads(storage.read_bytes("meta.json").decode())
+    # BEFORE any chunk byte: logical-shape / writer-world compatibility is
+    # decided up front as coded VSC13x findings (elastic.py) — an
+    # incompatible restore fails with both worlds named, not with an opaque
+    # error deep in the chunk loop
+    _report, elastic = preflight(meta, checkpoint_state, path)
     reader = _ChunkReader(storage)
     out: Dict[str, Any] = {}
     for top_key, tree in checkpoint_state.items():
@@ -544,7 +582,10 @@ def _load_impl(path: str, checkpoint_state: Dict[str, Any], strict: bool) -> Dic
             entry = meta["arrays"][full_key]
             if isinstance(leaf, DArray):
                 leaves.append(_load_darray(entry, reader, leaf))
-            elif isinstance(leaf, jax.Array):
+            elif isinstance(leaf, (jax.Array, jax.ShapeDtypeStruct)):
+                # abstract templates (ShapeDtypeStruct + sharding, e.g.
+                # DistributedOptimizer.state_template) load without ever
+                # materializing a throwaway zero state
                 leaves.append(_load_jax_array(entry, reader, leaf))
             else:
                 leaves.append(_relayout(_assemble_full(entry, reader), leaf))
@@ -552,6 +593,7 @@ def _load_impl(path: str, checkpoint_state: Dict[str, Any], strict: bool) -> Dic
         out[top_key] = jax.tree_util.tree_unflatten(flat_with_path[1], leaves)
     LAST_LOAD_STATS["bytes_read"] = reader.bytes_read
     LAST_LOAD_STATS["files_read"] = reader.files_read
+    LAST_LOAD_STATS["elastic"] = int(elastic)
     return out
 
 

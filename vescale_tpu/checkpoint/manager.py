@@ -154,8 +154,15 @@ class CheckpointManager:
         listing can disagree (a shared filesystem propagating a commit, a
         straggler that missed a prune), and ranks restoring DIFFERENT steps
         is a guaranteed desync; the intersection-of-committed-sets makes the
-        choice identical everywhere by construction.  Single-process:
-        ``latest_step``.  ``timeout_s`` as in ``distributed.barrier``."""
+        choice identical everywhere by construction.
+
+        Elastic join/leave: a rank with NO committed steps at all (a
+        freshly joined replacement after a capacity change, an empty
+        scratch dir) ABSTAINS instead of vetoing — it adopts whatever the
+        populated ranks agree on and restores that step from the shared
+        root.  Only when every rank is empty is there nothing to restore.
+        Single-process: ``latest_step``.  ``timeout_s`` as in
+        ``distributed.barrier``."""
         if jax.process_count() == 1:
             return self.latest_step()
         from ..distributed import allgather_ints
@@ -167,10 +174,34 @@ class CheckpointManager:
         mine = self._committed_steps()[-K:]
         row = [-1] * (K - len(mine)) + mine
         rows = allgather_ints(row, tag="ckpt_latest_common", timeout_s=timeout_s)
-        common = {int(v) for v in rows[0] if v >= 0}
-        for r in rows[1:]:
-            common &= {int(v) for v in r if v >= 0}
+        return self._common_from_rows(rows)
+
+    @staticmethod
+    def _common_from_rows(rows) -> Optional[int]:
+        """Newest step in the intersection of every NON-EMPTY row (-1 pads;
+        an all--1 row is a joining rank with no local state and abstains).
+        Factored out so the join/leave policy is unit-testable without a
+        process rig."""
+        common: Optional[set] = None
+        for r in rows:
+            steps = {int(v) for v in r if v >= 0}
+            if not steps:
+                continue  # joining rank: adopt, don't veto
+            common = steps if common is None else common & steps
         return max(common) if common else None
+
+    def writer_meta(self, step: int) -> Optional[Dict[str, Any]]:
+        """The ``step``'s recorded writer world (see
+        ``checkpoint.read_writer_meta``): process/device counts + mesh
+        descriptors — what the resilience loop compares against its own
+        world to tell an elastic (cross-world) resume from a same-shape
+        one.  None for pre-elastic checkpoints or unreadable meta."""
+        from . import read_writer_meta
+
+        try:
+            return read_writer_meta(self.step_path(step))
+        except (OSError, ValueError):
+            return None
 
     def quarantine(self, step: int) -> Optional[str]:
         """Sideline a committed-but-unloadable step: rename its dir to

@@ -18,6 +18,12 @@ Resilience surfaces (resilience/):
     position is one counter.  Restore fast-forwards via the native
     ``vdl_seek`` (O(1) — skipped batches are never filled); rewinding
     reopens the file first (prefetch state cannot run backwards).
+  * ``elastic=True`` (env ``VESCALE_ELASTIC_LOADER``) keys every sample on
+    its GLOBAL row index instead of the per-rank partition, making the
+    global stream invariant to the (dp_world, per-rank batch) split; the
+    state then carries a rank-invariant global cursor so a resume onto a
+    different world size re-splits the position sample-exactly
+    (docs/resilience.md §Elastic world size).
 """
 
 from __future__ import annotations
@@ -35,7 +41,12 @@ __all__ = ["TokenDataLoader", "build_native"]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _SRC = os.path.join(_NATIVE_DIR, "dataloader.cpp")
-_SO = os.path.join(_NATIVE_DIR, "libvdl.so")
+_ABI_VERSION = 2  # must match dataloader.cpp vdl_abi_version()
+# ABI-versioned output name: a stale .so from an older C-API can otherwise
+# shadow a rebuild forever (dlopen dedups by pathname, so reloading the
+# same path after a rebuild returns the cached stale handle) and silently
+# ignore trailing vdl_open arguments
+_SO = os.path.join(_NATIVE_DIR, f"libvdl.abi{_ABI_VERSION}.so")
 _BUILD_LOCK = threading.Lock()
 _LIB = None
 
@@ -55,6 +66,15 @@ def _lib():
     if _LIB is None:
         so = build_native()
         lib = ctypes.CDLL(so)
+        if not hasattr(lib, "vdl_abi_version") or lib.vdl_abi_version() != _ABI_VERSION:
+            # can only mean the versioned .so on disk was built from
+            # mismatched source; a re-CDLL of the same path would return
+            # the cached stale dlopen handle, so there is no in-process
+            # recovery — fail loudly
+            raise RuntimeError(
+                f"native loader {so} does not export ABI v{_ABI_VERSION}; "
+                "remove it and restart (stale build artifact)"
+            )
         lib.vdl_open.restype = ctypes.c_void_p
         lib.vdl_open.argtypes = [
             ctypes.c_char_p,
@@ -65,6 +85,7 @@ def _lib():
             ctypes.c_int64,
             ctypes.c_int64,
             ctypes.c_int,
+            ctypes.c_int,
         ]
         lib.vdl_next.restype = ctypes.c_int
         lib.vdl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
@@ -72,9 +93,8 @@ def _lib():
         lib.vdl_num_tokens.argtypes = [ctypes.c_void_p]
         lib.vdl_close.restype = None
         lib.vdl_close.argtypes = [ctypes.c_void_p]
-        if hasattr(lib, "vdl_seek"):  # absent only with a stale prebuilt .so
-            lib.vdl_seek.restype = ctypes.c_int
-            lib.vdl_seek.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.vdl_seek.restype = ctypes.c_int
+        lib.vdl_seek.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         _LIB = lib
     return _LIB
 
@@ -98,13 +118,24 @@ class TokenDataLoader:
         dp_world: int = 1,
         token_dtype=np.uint16,
         num_prefetch_threads: int = 2,
+        elastic: Optional[bool] = None,
     ):
         token_bytes = np.dtype(token_dtype).itemsize
         if token_bytes not in (2, 4):
             raise ValueError("token dtype must be 2 or 4 bytes")
+        if elastic is None:
+            from ..analysis import envreg
+
+            elastic = envreg.get_bool("VESCALE_ELASTIC_LOADER")
         self.batch, self.seq_len = batch, seq_len
         self.path = path
         self.seed, self.dp_rank, self.dp_world = int(seed), int(dp_rank), int(dp_world)
+        # elastic: samples are keyed on their GLOBAL row index over the full
+        # token span, so the global stream is invariant to the
+        # (dp_world, per-rank batch) factorization of a fixed global batch —
+        # the property that lets a resume re-split the position across a
+        # world-size change (docs/resilience.md §Elastic restore)
+        self.elastic = bool(elastic)
         self._token_bytes = token_bytes
         self._nprefetch = num_prefetch_threads
         # the lib handle is cached ON the instance: __del__ during
@@ -125,6 +156,7 @@ class TokenDataLoader:
             self.dp_rank,
             self.dp_world,
             self._nprefetch,
+            1 if self.elastic else 0,
         )
         if not h:
             raise OSError(f"cannot open token file {self.path!r} (too small or unreadable)")
@@ -192,30 +224,86 @@ class TokenDataLoader:
         (seed, dp_rank, dp_world, batch index), so the stream is one
         counter plus its identity coords (dp coords are part of the state
         because restoring rank r's counter into rank q's stream would
-        silently change the data)."""
-        return {
+        silently change the data).
+
+        Elastic mode adds the rank-INVARIANT global cursor
+        (``samples_served`` = global rows consumed, ``global_batch`` =
+        rows per global step): a resume onto a different
+        (dp_world, per-rank batch) split of the SAME global batch re-derives
+        its per-rank position from it — no sample skipped or replayed."""
+        st = {
             "batches_served": int(self._batches_served),
             "seed": self.seed,
             "dp_rank": self.dp_rank,
             "dp_world": self.dp_world,
             "batch": int(self.batch),
             "seq_len": int(self.seq_len),
+            "elastic": int(self.elastic),
         }
+        if self.elastic:
+            gb = int(self.batch) * int(self.dp_world)
+            st["global_batch"] = gb
+            st["samples_served"] = int(self._batches_served) * gb
+        return st
 
     def load_state(self, state: Dict[str, int]) -> None:
         """Position the stream so the next ``next()`` returns batch
         ``state['batches_served']`` — sample-exact resume.  Forward moves
         use the native seek (O(1)); backward moves (rollback) reopen the
-        file and seek from zero.  Identity coords must match: a loader
-        built for different dp coords / shape is a DIFFERENT stream."""
-        for key in ("seed", "dp_rank", "dp_world", "batch", "seq_len"):
-            if key in state and int(state[key]) != int(getattr(self, key)):
+        file and seek from zero.
+
+        Identity coords must match — a loader built for different dp
+        coords / shape is a DIFFERENT stream — EXCEPT when both sides are
+        elastic: then the split (``dp_rank``/``dp_world``/``batch``) may
+        change freely and the position is re-derived from the global cursor
+        (``samples_served // global_batch``), provided seed, seq_len and
+        the global batch are preserved (a changed global batch cannot be
+        re-split sample-exactly: VSC133)."""
+        resplit = (
+            self.elastic
+            and bool(state.get("elastic"))
+            and "samples_served" in state
+            and any(
+                int(state.get(k, getattr(self, k))) != int(getattr(self, k))
+                for k in ("dp_rank", "dp_world", "batch")
+            )
+        )
+        if resplit:
+            for key in ("seed", "seq_len"):
+                if key in state and int(state[key]) != int(getattr(self, key)):
+                    raise ValueError(
+                        f"loader state mismatch on {key!r}: checkpoint has "
+                        f"{state[key]}, this loader has {getattr(self, key)} — "
+                        "resuming would silently change the data stream"
+                    )
+            gb = int(self.batch) * int(self.dp_world)
+            saved_gb = int(state.get("global_batch", -1))
+            if saved_gb != gb:
                 raise ValueError(
-                    f"loader state mismatch on {key!r}: checkpoint has "
-                    f"{state[key]}, this loader has {getattr(self, key)} — "
-                    "resuming would silently change the data stream"
+                    f"[VSC133] loader position cannot be re-split: checkpoint "
+                    f"global batch is {saved_gb} rows, this run's is {gb} — an "
+                    "elastic resume must preserve batch*dp_world (change the "
+                    "per-rank batch, not the global one)"
                 )
-        target = int(state["batches_served"])
+            target = int(state["samples_served"]) // gb
+        else:
+            # "elastic" is an identity coord too: the two modes key samples
+            # differently, so a state crossing the mode boundary would
+            # silently switch the stream even at identical dp coords
+            for key in ("seed", "dp_rank", "dp_world", "batch", "seq_len", "elastic"):
+                if key in state and int(state[key]) != int(getattr(self, key)):
+                    raise ValueError(
+                        f"loader state mismatch on {key!r}: checkpoint has "
+                        f"{state[key]}, this loader has {int(getattr(self, key))} — "
+                        "resuming would silently change the data stream"
+                        + (
+                            " (enable elastic=True on BOTH runs to re-split "
+                            "across a world-size change)"
+                            if key in ("dp_rank", "dp_world", "batch")
+                            else ""
+                        )
+                    )
+            target = int(state["batches_served"])
         if self._h is None:
             raise RuntimeError(f"TokenDataLoader({self.path!r}) is closed")
         if target < self._batches_served:
@@ -231,27 +319,14 @@ class TokenDataLoader:
         self._batches_served = target
 
     def _seek(self, target: int) -> None:
-        if hasattr(self._lib, "vdl_seek"):
-            rc = self._lib.vdl_seek(self._h, target)
-            if rc != 0:
-                raise RuntimeError(
-                    f"native loader seek to {target} failed: rc={rc} (path={self.path!r})"
-                )
-            return
-        # stale .so without vdl_seek: drain-and-discard fallback
-        x = np.empty((self.batch, self.seq_len), np.int32)
-        y = np.empty((self.batch, self.seq_len), np.int32)
-        for _ in range(target - self._batches_served):
-            rc = self._lib.vdl_next(
-                self._h,
-                x.ctypes.data_as(ctypes.c_void_p),
-                y.ctypes.data_as(ctypes.c_void_p),
+        # vdl_seek always exists: _lib() enforces the ABI version, and every
+        # ABI >= 1 exports it (the pre-seek drain-and-discard fallback died
+        # with the ABI-versioned .so name)
+        rc = self._lib.vdl_seek(self._h, target)
+        if rc != 0:
+            raise RuntimeError(
+                f"native loader seek to {target} failed: rc={rc} (path={self.path!r})"
             )
-            if rc != 0:
-                raise RuntimeError(
-                    f"native loader failed during fast-forward: vdl_next rc={rc} "
-                    f"(path={self.path!r})"
-                )
 
     def __iter__(self):
         while True:

@@ -8,16 +8,28 @@
 // filled buffers.
 //
 // C API (see data/loader.py):
-//   vdl_open(path, token_bytes, seq_len, batch, seed, rank, world, nprefetch)
+//   vdl_open(path, token_bytes, seq_len, batch, seed, rank, world, nprefetch,
+//            elastic)
 //   vdl_next(handle, x_out, y_out)   -> blocks until a batch is ready
 //   vdl_seek(handle, index)          -> forward-seek the serve cursor
 //   vdl_num_tokens(handle)
+//   vdl_abi_version()                -> bumped on any signature change so a
+//                                       stale prebuilt .so forces a rebuild
 //   vdl_close(handle)
 //
-// Sampling: deterministic per (seed, rank, batch_index) via SplitMix64 —
-// rank r of `world` draws from a disjoint stream, so DP ranks see different
+// Sampling, elastic == 0 (historical default): deterministic per
+// (seed, rank, batch_index) via SplitMix64 — rank r of `world` draws from a
+// disjoint start-offset partition of the file, so DP ranks see different
 // data while runs are reproducible.  x = tokens[i : i+seq_len],
 // y = tokens[i+1 : i+seq_len+1] (next-token targets).
+//
+// Sampling, elastic == 1: every sample is keyed on its GLOBAL row index
+//   g = batch_index * (batch * world) + rank * batch + row
+// over the FULL span — the global token stream is a pure function of
+// (seed, g), invariant to how (world, per-rank batch) split a fixed global
+// batch.  This is what makes a checkpoint resumable on a different world
+// size with no sample skipped or replayed (elastic world-size resume);
+// rank r still serves the contiguous global-batch slice [r*batch,(r+1)*batch).
 
 #include <atomic>
 #include <condition_variable>
@@ -61,6 +73,7 @@ struct Loader {
   int64_t batch = 0;
   uint64_t seed = 0;
   int64_t rank = 0, world = 1;
+  int elastic = 0;  // world-invariant global-row sampling (header comment)
   std::atomic<uint64_t> batch_counter{0};
 
   // prefetch ring, served strictly in batch-index order so multi-threaded
@@ -87,9 +100,25 @@ struct Loader {
   void fill(Batch& b, uint64_t index) {
     b.x.resize(batch * seq_len);
     b.y.resize(batch * seq_len);
+    size_t full_span = num_tokens - (size_t)seq_len - 1;
+    if (elastic) {
+      // world-invariant: sample g = global row index over the FULL span —
+      // any (world, per-rank batch) factorization of the same global batch
+      // reproduces the identical global token stream (elastic resume)
+      for (int64_t row = 0; row < batch; ++row) {
+        uint64_t g = index * (uint64_t)(batch * world) +
+                     (uint64_t)rank * (uint64_t)batch + (uint64_t)row;
+        SplitMix64 rng(seed * 0x9E3779B97F4A7C15ull + g * 0xD1B54A32D192ED03ull);
+        size_t start = (size_t)(rng.next() % full_span);
+        for (int64_t t = 0; t < seq_len; ++t) {
+          b.x[row * seq_len + t] = token_at(start + t);
+          b.y[row * seq_len + t] = token_at(start + t + 1);
+        }
+      }
+      return;
+    }
     // deterministic per (seed, rank, batch index); ranks draw from DISJOINT
     // start-offset partitions of the file so dp shards never overlap
-    size_t full_span = num_tokens - (size_t)seq_len - 1;
     size_t rank_span = full_span / (size_t)world;
     size_t rank_base = (size_t)rank * rank_span;
     if (rank_span == 0) {  // degenerate tiny file: fall back to shared span
@@ -137,8 +166,14 @@ struct Loader {
 
 extern "C" {
 
+// bumped on any C-API signature change: the Python side refuses (and
+// rebuilds) a stale prebuilt .so instead of calling through a mismatched
+// ABI, where an extra trailing argument would be SILENTLY ignored
+int vdl_abi_version() { return 2; }
+
 void* vdl_open(const char* path, int token_bytes, int64_t seq_len, int64_t batch,
-               uint64_t seed, int64_t rank, int64_t world, int n_prefetch) {
+               uint64_t seed, int64_t rank, int64_t world, int n_prefetch,
+               int elastic) {
   auto* L = new Loader();
   L->token_bytes = token_bytes;
   L->seq_len = seq_len;
@@ -146,6 +181,7 @@ void* vdl_open(const char* path, int token_bytes, int64_t seq_len, int64_t batch
   L->seed = seed;
   L->rank = rank;
   L->world = world <= 0 ? 1 : world;
+  L->elastic = elastic != 0 ? 1 : 0;
   L->fd = ::open(path, O_RDONLY);
   if (L->fd < 0) {
     delete L;

@@ -177,6 +177,21 @@ class FSDPParamBuffer:
         """Re-attach the ragged shardings to computed physical buffers."""
         return {name: self._attach(physicals[name], g.spec) for name, g in self.groups.items()}
 
+    def buffer_templates(self) -> Dict[str, Any]:
+        """``{dtype_name: DArray template (no data)}`` of the flat ragged
+        buffers — the elastic-restore template for flattened FSDP state.
+
+        A world-size change re-balances ``_balanced_units`` (shard
+        boundaries move to new param boundaries), so a checkpoint written
+        under one bucketing must be RE-BUCKETED on load: passing these
+        templates to ``checkpoint.load`` fills each new rank's flat range
+        from whichever old ranks' saved chunks intersect it (flat-box
+        intersection in ``checkpoint/reshard.py``).  Works for the param
+        buffers and for optimizer-state buffers carrying the same spec."""
+        from ..darray import DArray
+
+        return {name: DArray(None, g.spec) for name, g in self.groups.items()}
+
     def local_params(self, rank: int) -> List[Tuple[int, int]]:
         """[(param_index, intra-param offset)...] fully/partially owned by
         ``rank`` — the communication-free checkpoint chunk map."""

@@ -383,6 +383,43 @@ class DistributedOptimizer:
 
         return jax.tree_util.tree_map_with_path(one, state)
 
+    def state_template(self, params):
+        """Abstract optimizer-state tree for checkpoint restore: every leaf
+        is a ``jax.ShapeDtypeStruct`` carrying THIS optimizer's ZeRO
+        sharding (``state_pspecs`` recomputed for the current mesh/world).
+
+        This is the elastic-restore entry point (docs/resilience.md):
+        after a world-size change, build the optimizer for the NEW mesh,
+        pass ``state_template(params)`` as the ``"optimizer"`` template to
+        ``checkpoint.load`` and each new rank's ranges — the reference's
+        gbuf range maps, here the pspec-derived chunk boxes — are filled
+        from the old ranks' saved chunks by box intersection, without ever
+        materializing a throwaway zero state."""
+        state = jax.eval_shape(self.init, params)
+        if self.mesh is None or self.param_pspecs is None:
+            return jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype) if hasattr(l, "shape") else l,
+                state,
+            )
+        param_paths, pspec_by_path = _param_path_maps(params, self.param_pspecs)
+        jm = self.mesh.jax_mesh
+
+        def one(kp, leaf):
+            if not hasattr(leaf, "shape"):
+                return leaf
+            if len(leaf.shape) == 0:
+                # scalars (step counters) stay uncommitted so jit may
+                # co-locate them — the same policy as the load path
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+            ps = _state_pspec(
+                kp, tuple(leaf.shape), param_paths, pspec_by_path, self.mesh, self.dp_dims
+            )
+            return jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=NamedSharding(jm, ps or PartitionSpec())
+            )
+
+        return jax.tree_util.tree_map_with_path(one, state)
+
 
 # ----------------------------------------------------------- low-mem adamw
 class ScaleByAdamLowmemState(NamedTuple):

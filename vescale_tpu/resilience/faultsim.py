@@ -42,6 +42,12 @@ Fault kinds and their hook sites:
                     sleeps ``VESCALE_FAULTSIM_HANG_S`` (default 3600)
                     seconds, simulating a wedged collective so the
                     watchdog's detect/dump/abort path is exercisable
+  resize            observed by ``run_resilient`` — simulated capacity
+                    change at step N: the loop drains in-flight saves,
+                    emergency-saves, and returns ``status="resized"`` so
+                    a supervisor can relaunch on a different world size
+                    (the elastic-restore test substrate,
+                    scripts/elastic_smoke.py)
   ================  ====================================================
 
 Gating contract (the ``telemetry.init()`` pattern): while disarmed the
@@ -82,6 +88,7 @@ KINDS = (
     "preempt",
     "oom",
     "hang",
+    "resize",
 )
 
 # errors raised by `check` per kind; observation-level kinds (nonfinite_loss,

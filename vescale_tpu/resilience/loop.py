@@ -18,6 +18,18 @@ the optimizer's skip-on-nonfinite signal, and the OOM flight recorder
   SIGTERM / SIGINT       stop flag checked at the step boundary: drain
                          in-flight async saves, one emergency SYNCHRONOUS
                          save, clean return (status="preempted").
+  capacity change        the faultsim "resize" kind (OR-agreed across
+                         ranks in coordinated mode) drains and
+                         emergency-saves exactly like a preemption but
+                         returns status="resized"; the relaunched run may
+                         come back with a DIFFERENT process count/mesh —
+                         restore reshards params AND optimizer state from
+                         the saved chunks (the writer-mesh block in
+                         meta.json routes the shape change to the chunk-box
+                         reshard, VSC130) and the elastic loader re-splits
+                         its global sample cursor, so the continuation is
+                         bit-identical (scripts/elastic_smoke.py proves
+                         2->1 and 1->2).
   NaN / loss-spike burst after ``threshold`` consecutive anomalous steps
                          (non-finite loss, optimizer skip, or z-score
                          spike) roll back to the last good checkpoint and
@@ -92,11 +104,12 @@ from .watchdog import Watchdog
 
 __all__ = ["AnomalyPolicy", "RunResult", "run_resilient"]
 
-# control-plane vector: [magic, step, preempt, bad_streak, rollbacks,
-# fp_due, <consistency fingerprint fields when fp_due>].  Exchanged every
-# step in coordinated mode; preempt is an OR, everything else must agree.
+# control-plane vector: [magic, step, preempt, resize, bad_streak,
+# rollbacks, fp_due, <consistency fingerprint fields when fp_due>].
+# Exchanged every step in coordinated mode; preempt and resize are ORs,
+# everything else must agree.
 _COORD_MAGIC = 0x7E5C0
-_COORD_FIELDS = ("coord_magic", "step", "preempt", "bad_streak", "rollbacks", "fp_due")
+_COORD_FIELDS = ("coord_magic", "step", "preempt", "resize", "bad_streak", "rollbacks", "fp_due")
 
 
 @dataclass
@@ -122,7 +135,7 @@ class RunResult:
     params: Any
     opt_state: Any
     step: int  # last COMPLETED step (-1: none)
-    status: str  # "completed" | "preempted"
+    status: str  # "completed" | "preempted" | "resized"
     restarts: int = 0
     rollbacks: int = 0
     quarantined: int = 0
@@ -211,6 +224,8 @@ def run_resilient(
     import jax
 
     from .. import telemetry as _tel
+    from ..checkpoint import LAST_LOAD_STATS as _load_stats
+    from ..checkpoint.elastic import ElasticMismatchError as _ElasticMismatch
     from ..telemetry import memtrack as _memtrack
 
     if not _fs.is_armed():
@@ -264,6 +279,7 @@ def run_resilient(
     restart_attempts = 0
     last_rollback_target: Optional[int] = None
     escalate_skip = False
+    resize_requested = False  # faultsim "resize": simulated capacity change
 
     def _extra_state(completed_step: int) -> Dict[str, Any]:
         # `completed_step` is the step whose output result.params holds;
@@ -293,13 +309,14 @@ def run_resilient(
             return manager.latest_common_step(timeout_s=barrier_timeout_s)
         return manager.latest_step()
 
-    def _coordinate() -> bool:
-        """One control-plane allgather: agree on preemption, verify the
-        ranks are marching in lockstep, and (on the consistency cadence)
-        compare state fingerprints.  Returns the AGREED preemption flag;
-        raises ``DesyncError`` on any disagreement — symmetric on every
-        rank, and always BEFORE the next save could commit divergent
-        state."""
+    def _coordinate() -> tuple:
+        """One control-plane allgather: agree on preemption and resize,
+        verify the ranks are marching in lockstep, and (on the consistency
+        cadence) compare state fingerprints.  Returns the AGREED
+        ``(preempt, resize)`` flags (both ORs — any rank's capacity event
+        drains everyone); raises ``DesyncError`` on any disagreement —
+        symmetric on every rank, and always BEFORE the next save could
+        commit divergent state."""
         from ..distributed import allgather_ints
 
         fp = None
@@ -317,6 +334,7 @@ def run_resilient(
             _COORD_MAGIC,
             step,
             1 if handler.requested() else 0,
+            1 if resize_requested else 0,
             bad_streak,
             result.rollbacks,
             0 if fp is None else 1,
@@ -334,10 +352,12 @@ def run_resilient(
             # cannot mismatch — skip the compares, keep the counters honest
             if fp is not None:
                 _tel.count("consistency_checks_total")
-            return bool(vec[2])
+            return bool(vec[2]), bool(vec[3])
         preempt_any = bool(rows[:, 2].any())
+        resize_any = bool(rows[:, 3].any())
         mismatched = _cons.compare_rows(rows[:, : len(_COORD_FIELDS)], _COORD_FIELDS)
         mismatched.pop("preempt", None)  # an OR, not an agreement
+        mismatched.pop("resize", None)  # likewise
         if not mismatched and fp is not None:
             _tel.count("consistency_checks_total")
             mismatched = _cons.compare_rows(rows[:, len(_COORD_FIELDS) :], _cons.FIELDS)
@@ -351,7 +371,7 @@ def run_resilient(
             raise _cons.DesyncError(mismatched, rows)
         if preempt_any and not handler.requested():
             handler.request()  # a PEER was preempted; we drain with it
-        return preempt_any
+        return preempt_any, resize_any
 
     def _restore_latest() -> Optional[int]:
         """Restore the newest committed checkpoint, quarantining any that
@@ -368,6 +388,7 @@ def run_resilient(
                 return None
             template = _ckpt_state(0)
             restore_err: Optional[Exception] = None
+            t_restore = time.perf_counter()
             try:
                 restored = manager.restore(template, step=target)
             except KeyError as e:
@@ -381,6 +402,18 @@ def run_resilient(
                     f"state schema ({e}); refusing to quarantine a "
                     "structurally incompatible (not corrupt) checkpoint — "
                     "restore it manually or resume with matching state"
+                ) from e
+            except _ElasticMismatch as e:
+                # CODED verdict (VSC131/VSC132) from the pre-read preflight:
+                # the checkpoint is fine, the worlds are incompatible — a
+                # deterministic property of every committed step, so (like
+                # the schema case above) quarantining would sideline all
+                # the good saves.  A pure mesh/world change never lands
+                # here: the writer block routes it to reshard-on-load.
+                raise RuntimeError(
+                    f"checkpoint step {target} cannot be restored into this "
+                    f"run's world ({e}); refusing to quarantine a "
+                    "structurally incompatible (not corrupt) checkpoint"
                 ) from e
             except Exception as e:  # corrupt-but-committed on THIS rank
                 restore_err = e
@@ -423,6 +456,17 @@ def run_resilient(
             result.step = int(extra["step"])
             step = int(extra["step"]) + 1
             data_cursor = int(extra["data_cursor"])  # already next-batch index
+            if _load_stats.get("elastic"):
+                # the checkpoint's writer world differed: this restore WAS
+                # the cross-world reshard (VSC130); load() already counted
+                # resilience_elastic_restores_total / reshard_seconds
+                wm = manager.writer_meta(target) if hasattr(manager, "writer_meta") else None
+                _event(
+                    "elastic_restore",
+                    ckpt_step=target,
+                    writer=wm,
+                    reshard_seconds=time.perf_counter() - t_restore,
+                )
             if loader is not None:
                 loader.load_state(jax.tree_util.tree_map(int, extra["loader"]))
             saved_seed = int(extra["rng_seed"])
@@ -466,10 +510,14 @@ def run_resilient(
                 time.sleep(envreg.get_float("VESCALE_FAULTSIM_HANG_S"))
             if _fs.fires("preempt", ctx=f"step{step}"):
                 handler.request()
+            if _fs.fires("resize", ctx=f"step{step}"):
+                resize_requested = True  # simulated capacity change: drain
+                # and exit "resized" so a supervisor relaunches on the new
+                # world size and elastic auto-resume takes over
             # coordinated mode: one control-plane allgather — agreed
-            # preemption, lockstep verification, cadenced fingerprints
+            # preemption/resize, lockstep verification, cadenced fingerprints
             if coord:
-                preempt_now = _coordinate()
+                preempt_now, resize_now = _coordinate()
             else:
                 # an explicitly-armed checker still runs its cadence
                 # (trivially consistent alone, but the counters stay honest
@@ -484,9 +532,16 @@ def run_resilient(
                         opt_state=result.opt_state,
                     )
                 preempt_now = handler.requested()
-            if preempt_now:
-                result.status = "preempted"
-                _tel.count("resilience_preemptions_total")
+                resize_now = resize_requested
+            if preempt_now or resize_now:
+                # preemption wins when both fire in the same boundary (the
+                # SIGTERM deadline is the harder constraint); the drain +
+                # emergency-save choreography is identical either way
+                result.status = "preempted" if preempt_now else "resized"
+                _tel.count(
+                    "resilience_preemptions_total" if preempt_now
+                    else "resilience_resizes_total"
+                )
                 # no emergency save mid-anomaly-streak: result.params may be
                 # poisoned, and a preemption must not promote them to the
                 # newest committed checkpoint (resume replays from the last
@@ -499,9 +554,9 @@ def run_resilient(
                         _tel.count("resilience_emergency_saves_total")
                         result.emergency_save_step = result.step
                 _event(
-                    "preempted",
+                    result.status,
                     at_step=result.step,
-                    signum=handler.signum,
+                    signum=handler.signum if preempt_now else None,
                     emergency_save=result.emergency_save_step,
                 )
                 return result
