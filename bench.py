@@ -824,6 +824,15 @@ def _dispatch():
         import redistribute_bench
 
         print(json.dumps(redistribute_bench.run_bench()))
+    elif which == "quantcomm":
+        # quantized gradient collectives (VESCALE_BENCH=quantcomm): the
+        # 2-proc gloo rig's grad-reduce bytes-on-the-wire + step time,
+        # fp32 psum vs block-scaled int8, plus the emulator bit-for-bit
+        # verdict — scripts/quantcomm_smoke.py emits the line
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import quantcomm_smoke
+
+        print(json.dumps(quantcomm_smoke.run_bench()))
     else:
         main()
 

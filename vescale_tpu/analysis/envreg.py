@@ -211,7 +211,19 @@ def configuration_markdown() -> str:
 register("VESCALE_SHARDCHECK", "str", "warn",
          "Static-analysis mode: `off` disables, `warn` emits warnings, `strict` raises on error-severity findings (docs/observability.md).")
 
+# --- gradient compression / quantized collectives --------------------
+register("VESCALE_GRAD_COMPRESS", "str", "",
+         "Gradient-compression codec for DDP/ZeRO grad reduction: empty = off, `int8` = block-scaled int8 quantized collectives (docs/observability.md).")
+register("VESCALE_GRAD_COMPRESS_BLOCK", "int", 64,
+         "Block size (elements per fp32 scale) for the int8 gradient quantizer.")
+register("VESCALE_GRAD_COMPRESS_SR", "bool", False,
+         "Use seeded stochastic rounding (unbiased in expectation) instead of round-to-nearest-even for quantized gradient collectives.")
+register("VESCALE_GRAD_COMPRESS_SEED", "int", 0,
+         "Seed for the stochastic-rounding PRNG of quantized collectives; each eager call folds in a process-wide call counter and each rank its mesh position, so noise is fresh per step/leaf yet replayable from (seed, call order).")
+
 # --- redistribution --------------------------------------------------
+register("VESCALE_REDISTRIBUTE_QUANT", "bool", False,
+         "Let the multi-hop redistribution planner take a LOSSY quantize-move-dequantize int8 hop where the cost model says it wins; declines are recorded as VSC127 (docs/redistribute.md).")
 register("VESCALE_REDISTRIBUTE_MEM_FACTOR", "float", 4.0,
          "Per-shard memory budget for multi-hop plan intermediates, as a multiple of the larger endpoint shard.")
 register("VESCALE_REDISTRIBUTE_MAX_HOPS", "int", 3,

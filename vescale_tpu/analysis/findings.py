@@ -97,6 +97,10 @@ _CODE_DEFS: Tuple[Tuple[str, Severity, str], ...] = (
      "cross-mesh: destination-side dress from the bridge form failed"),
     ("VSC126", Severity.INFO,
      "planner was not consulted for this spec pair"),
+    ("VSC127", Severity.INFO,
+     "quantized (int8) redistribution hop declined: cost model or layout does not favor it"),
+    ("VSC128", Severity.INFO,
+     "transition routed through a LOSSY int8-quantized hop (gated by VESCALE_REDISTRIBUTE_QUANT)"),
     # --- VSC13x: elastic restore (cross-world checkpoint compatibility) --
     ("VSC130", Severity.INFO,
      "checkpoint written by a different mesh/world size; resharding on load"),

@@ -942,19 +942,40 @@ def check_transition(src_spec, dst_spec, where: Optional[str] = None) -> List[Fi
     """Findings for one ``redistribute(src -> dst)``: VSC106 (error, with
     the planner's structured decline code in the message) when the move
     would hit the logical-materializing fallback; VSC108 (info, costed)
-    when the multi-hop planner serves it."""
+    when the multi-hop planner serves it.  With the quant-hop gate on
+    (``VESCALE_REDISTRIBUTE_QUANT``) the quantized-route decision is
+    surfaced like every other hop: VSC128 (info) when the cost model takes
+    the lossy int8 hop, the recorded VSC127 decline otherwise."""
     from ..redistribute import classify_transition
-    from ..redistribute_plan import decline_finding, plan_redistribute
+    from ..redistribute_plan import decline_finding, plan_redistribute, quant_outcome
 
     if src_spec == dst_spec:
         return []
-    tier = classify_transition(src_spec, dst_spec)
+    quant_findings: List[Finding] = []
     label = where or f"{list(map(str, src_spec.placements))} -> {list(map(str, dst_spec.placements))}"
+    qo = quant_outcome(src_spec, dst_spec)
+    if qo is not None:
+        verdict, payload = qo
+        if verdict == "taken":
+            quant_findings.append(Finding(
+                CODES["VSC128"],
+                f"cost model routes this transition through a lossy "
+                f"int8-quantized {'/'.join(payload.collectives)} hop "
+                f"(~{payload.bytes_moved / 2**20:.2f} MiB packed vs "
+                f"~{payload.bytes_raw / 2**20:.2f} MiB raw per device)",
+                where=label,
+                bytes_est=payload.bytes_moved,
+            ))
+        elif payload is not None:
+            qf = payload.finding()
+            qf.where = label
+            quant_findings.append(qf)
+    tier = classify_transition(src_spec, dst_spec)
     if tier == "fallback":
         decline = decline_finding(src_spec, dst_spec)
         df = decline.finding()
         df.where = label
-        return [Finding(
+        return quant_findings + [Finding(
             CODES["VSC106"],
             f"transition would materialize the logical tensor "
             f"(~{src_spec.logical_bytes() / 2**20:.1f} MiB vs "
@@ -966,14 +987,16 @@ def check_transition(src_spec, dst_spec, where: Optional[str] = None) -> List[Fi
     if tier == "planned":
         plan = plan_redistribute(src_spec, dst_spec)
         if plan is not None:
-            return [Finding(
+            n_quant = sum(1 for h in plan.hops if h.kind == "quant")
+            return quant_findings + [Finding(
                 CODES["VSC108"],
                 f"resolved by a {len(plan.hops)}-hop plan moving "
-                f"~{plan.bytes_moved / 2**20:.2f} MiB per device",
+                f"~{plan.bytes_moved / 2**20:.2f} MiB per device"
+                + (f" ({n_quant} int8-quantized hop(s))" if n_quant else ""),
                 where=label,
                 bytes_est=plan.bytes_moved,
             )]
-    return []
+    return quant_findings
 
 
 def check_stage_boundaries(

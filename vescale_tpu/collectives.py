@@ -19,6 +19,7 @@ operand" and collectives combine along it.
 from __future__ import annotations
 
 import functools
+import itertools
 from typing import Optional, Union
 
 import numpy as np
@@ -64,6 +65,13 @@ __all__ = [
     "mesh_broadcast",
     "mesh_scatter",
     "mesh_ppermute",
+    "all_reduce_q",
+    "reduce_scatter_q",
+    "next_sr_key",
+    "q_psum",
+    "q_all_gather",
+    "q_psum_scatter",
+    "q_all_to_all",
     "allgather_cost",
     "allreduce_cost",
     "reduce_scatter_cost",
@@ -190,6 +198,317 @@ def mesh_ppermute(tensor, mesh: DeviceMesh, mesh_dim=0, shift: int = 1):
         return jax.lax.ppermute(x, ax, perm)[None]
 
     return _smap(mesh, body, P(ax), P(ax))(tensor)
+
+
+# ------------------------------------------------- quantized collectives
+# Block-scaled int8 gradient collectives (ROADMAP item 2; EQuARX,
+# arXiv:2506.17615): quantize each rank's contribution ONCE (per-block fp32
+# scales, quant/blockscale.py), move a single packed int8 buffer on the
+# wire, and accumulate the dequantized contributions in a wide master dtype
+# in FIXED rank order — so the reduction can never overflow int8 and the
+# result is deterministic + bitwise replayable by the emulator's quantized
+# mode (emulator/quantized.py).  The ``q_*`` helpers run INSIDE a shard_map
+# body (an axis name in scope); ``all_reduce_q``/``reduce_scatter_q`` are
+# the eager stacked-convention wrappers mirroring ``mesh_all_reduce`` /
+# ``mesh_reduce_scatter``.
+#
+# Wire-dtype convention (debug/comm_mode.py keys on it): REDUCTION payloads
+# travel as signed int8 (HLO ``s8``) and pure data-MOVEMENT payloads as
+# unsigned int8 (``u8``), so compiled-HLO comm accounting can attribute an
+# s8 all-gather to a logical quantized all-reduce and a u8 collective to
+# its own logical op.
+
+def _rank_key(key, axis_name, rounding: str):
+    """Per-rank stochastic-rounding key: fold the mesh position into the
+    seed so ranks draw independent (but replayable) noise."""
+    if rounding != "stochastic":
+        return None
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+
+
+_SR_CALLS = itertools.count()
+
+
+def next_sr_key():
+    """A fresh stochastic-rounding key for ONE eager quantized reduction:
+    ``fold_in(key(VESCALE_GRAD_COMPRESS_SEED), call_index)``.  Successive
+    calls (steps, tree leaves) draw independent noise — reusing one key
+    across steps would correlate rounding errors into systematic drift,
+    the bias SR exists to remove — while the sequence stays a pure
+    function of (seed, call order), so a run is replayable end to end.
+    Jit-embedded callers can't use a host counter: they thread a key (or
+    ``step``) explicitly — see ``dp_grad_reduce``."""
+    from .analysis import envreg
+
+    seed = envreg.get_int("VESCALE_GRAD_COMPRESS_SEED") or 0
+    return jax.random.fold_in(jax.random.key(seed), next(_SR_CALLS))
+
+
+def _compress_settings(block, rounding):
+    """Resolve the static compression knobs: explicit args win, else the
+    registered VESCALE_GRAD_COMPRESS_* env defaults.  The ONE place the
+    block-size and rounding-mode precedence lives (the eager wrappers and
+    the DDP/ZeRO reduction path both call it)."""
+    from .analysis import envreg
+    from .quant import blockscale
+
+    if block is None:
+        block = envreg.get_int("VESCALE_GRAD_COMPRESS_BLOCK") or blockscale.DEFAULT_BLOCK
+    if rounding is None:
+        rounding = (
+            "stochastic" if envreg.get_bool("VESCALE_GRAD_COMPRESS_SR") else "nearest"
+        )
+    return int(block), rounding
+
+
+def _compress_defaults(block, rounding, key):
+    """``_compress_settings`` plus the key draw: an SR call without an
+    explicit key gets a FRESH counter-derived one (``next_sr_key``) — note
+    this is resolved at TRACE time under jit, where the caller should
+    thread a per-step key instead."""
+    block, rounding = _compress_settings(block, rounding)
+    if rounding == "stochastic" and key is None:
+        key = next_sr_key()
+    return block, rounding, key
+
+
+def q_psum(x, axis_name, n: int, *, block, rounding="nearest", key=None,
+           acc_dtype=jnp.float32, reduce_op: str = "sum"):
+    """Quantized all-reduce over ``axis_name`` (shard_map body helper):
+    quantize → all-gather one packed s8 buffer → dequantize-accumulate all
+    ``n`` contributions in ``acc_dtype`` in rank order."""
+    from .quant import blockscale
+
+    if reduce_op not in ("sum", "avg"):
+        raise ValueError(f"quantized reduction supports sum/avg, got {reduce_op!r}")
+    qb = blockscale.quantize_int8_blocks(x, block, rounding, _rank_key(key, axis_name, rounding))
+    payload = blockscale.pack_int8_payload(qb)
+    allp = jax.lax.all_gather(payload, axis_name, axis=0, tiled=False)  # (n, P)
+    nb = qb.q.shape[0]
+    acc = None
+    for r in range(n):  # fixed rank order: deterministic, emulator-replayable
+        qr = blockscale.unpack_int8_payload(allp[r], nb, block)
+        # the dequantize multiply is EXACT (power-of-two scales,
+        # blockscale.py), so backend FMA contraction of this mul into the
+        # accumulate add cannot change a bit — the emulator's
+        # mul-then-add replay stays bit-for-bit without fighting fusion
+        d = qr.q.astype(acc_dtype) * qr.scales.astype(acc_dtype)[:, None]
+        acc = d if acc is None else acc + d
+    if reduce_op == "avg":
+        acc = acc / n
+    return acc.reshape(-1)[: x.size].reshape(x.shape).astype(x.dtype)
+
+
+def _as_move_payload(payload):
+    # movement convention: u8 on the wire (see module comment)
+    return jax.lax.bitcast_convert_type(payload, jnp.uint8)
+
+
+def _from_move_payload(payload_u8):
+    return jax.lax.bitcast_convert_type(payload_u8, jnp.int8)
+
+
+def q_all_gather(x, axis_name, n: int, *, axis: int, extent: int, block,
+                 rounding="nearest", key=None, acc_dtype=jnp.float32):
+    """Quantized all-gather along tensor ``axis`` (shard_map body helper):
+    each rank's chunk moves as a packed u8 buffer; chunks are dequantized
+    and concatenated in rank order, trimmed to the logical ``extent``.
+    Lossy — every rank's data (including the caller's own chunk) round
+    trips through int8, so the result is REPLICATED consistently."""
+    from .quant import blockscale
+
+    qb = blockscale.quantize_int8_blocks(x, block, rounding, _rank_key(key, axis_name, rounding))
+    payload = _as_move_payload(blockscale.pack_int8_payload(qb))
+    allp = jax.lax.all_gather(payload, axis_name, axis=0, tiled=False)
+    nb = qb.q.shape[0]
+    parts = []
+    for r in range(n):
+        qr = blockscale.unpack_int8_payload(_from_move_payload(allp[r]), nb, block)
+        parts.append(blockscale.dequantize_int8_blocks(qr, x.shape, x.dtype, acc_dtype))
+    out = jnp.concatenate(parts, axis=axis)
+    if out.shape[axis] != extent:
+        out = jax.lax.slice_in_dim(out, 0, extent, axis=axis)
+    return out
+
+
+def q_psum_scatter(x, axis_name, n: int, *, scatter_dim: int, block,
+                   rounding="nearest", key=None, acc_dtype=jnp.float32,
+                   reduce_op: str = "sum"):
+    """Quantized reduce-scatter (shard_map body helper): the operand is
+    split into ``n`` chunks along ``scatter_dim`` (must divide evenly —
+    callers pad first), each chunk quantized separately so its blocks and
+    scales travel together through one packed s8 all-to-all; each rank
+    dequantize-accumulates its received chunks in rank order."""
+    from .quant import blockscale
+
+    if reduce_op not in ("sum", "avg"):
+        raise ValueError(f"quantized reduction supports sum/avg, got {reduce_op!r}")
+    if x.shape[scatter_dim] % n:
+        raise ValueError(
+            f"q_psum_scatter: dim {scatter_dim} extent {x.shape[scatter_dim]} "
+            f"not divisible by {n} (pad first)"
+        )
+    chunks = jnp.split(x, n, axis=scatter_dim)
+    key0 = _rank_key(key, axis_name, rounding)
+    payloads = []
+    nb = None
+    for c, chunk in enumerate(chunks):
+        kc = None if key0 is None else jax.random.fold_in(key0, c)
+        qb = blockscale.quantize_int8_blocks(chunk, block, rounding, kc)
+        nb = qb.q.shape[0]
+        payloads.append(blockscale.pack_int8_payload(qb))
+    stackp = jnp.stack(payloads)  # (n, P) s8
+    recv = jax.lax.all_to_all(stackp, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    acc = None
+    for r in range(n):
+        qr = blockscale.unpack_int8_payload(recv[r], nb, block)
+        # exact dequantize multiply: FMA-contraction-proof (see q_psum)
+        d = qr.q.astype(acc_dtype) * qr.scales.astype(acc_dtype)[:, None]
+        acc = d if acc is None else acc + d
+    if reduce_op == "avg":
+        acc = acc / n
+    cshape = chunks[0].shape
+    csize = 1
+    for s in cshape:
+        csize *= int(s)
+    return acc.reshape(-1)[:csize].reshape(cshape).astype(x.dtype)
+
+
+def q_all_to_all(x, axis_name, n: int, *, split_axis: int, concat_axis: int,
+                 block, rounding="nearest", key=None, acc_dtype=jnp.float32):
+    """Quantized all-to-all (shard_map body helper): split along
+    ``split_axis`` (must divide evenly), move packed u8 chunk payloads,
+    reassemble the received chunks along ``concat_axis`` in rank order.
+    Pure movement — lossy only through one quantize round trip."""
+    from .quant import blockscale
+
+    if x.shape[split_axis] % n:
+        raise ValueError(
+            f"q_all_to_all: dim {split_axis} extent {x.shape[split_axis]} "
+            f"not divisible by {n} (pad first)"
+        )
+    chunks = jnp.split(x, n, axis=split_axis)
+    key0 = _rank_key(key, axis_name, rounding)
+    payloads = []
+    nb = None
+    for c, chunk in enumerate(chunks):
+        kc = None if key0 is None else jax.random.fold_in(key0, c)
+        qb = blockscale.quantize_int8_blocks(chunk, block, rounding, kc)
+        nb = qb.q.shape[0]
+        payloads.append(_as_move_payload(blockscale.pack_int8_payload(qb)))
+    stackp = jnp.stack(payloads)  # (n, P) u8
+    recv = jax.lax.all_to_all(stackp, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    parts = []
+    for r in range(n):
+        qr = blockscale.unpack_int8_payload(_from_move_payload(recv[r]), nb, block)
+        parts.append(
+            blockscale.dequantize_int8_blocks(qr, chunks[0].shape, x.dtype, acc_dtype)
+        )
+    return jnp.concatenate(parts, axis=concat_axis)
+
+
+_WARNED_COUNTERPRODUCTIVE = set()
+
+
+def _compress_wire_bytes(n_elements: int, itemsize: int, block: int, op: str, n: int):
+    """WIRE-accurate per-device byte accounting for one quantized
+    collective vs its uncompressed form: the quantized all-reduce is
+    gather-based (moves (n-1) packed contributions vs the ring's
+    2(n-1)/n raw), so at large mesh dims it moves MORE — the telemetry
+    must say so rather than report payload-packing 'savings'."""
+    from .quant import blockscale
+
+    raw = n_elements * itemsize
+    packed = blockscale.packed_nbytes(n_elements, block)
+    f = (n - 1) / max(1, n)
+    if op == "all_reduce":
+        return 2.0 * f * raw, float((n - 1) * packed)
+    # reduce_scatter: all-to-all of packed chunks vs psum_scatter's ring
+    return f * raw, f * packed
+
+
+def _compress_telemetry(n_elements: int, itemsize: int, block: int, op: str, n: int):
+    """Byte-savings accounting per quantized collective call (eager
+    wrappers + DDP wiring), using the wire formulas above.  A
+    counterproductive configuration (quantized bytes >= raw bytes on the
+    wire — e.g. int8 all-reduce on a large dp dim) warns once per
+    (op, n) instead of crediting phantom savings."""
+    if n <= 1:
+        # size-1 mesh dim: no bytes move either way — count the call but
+        # record no savings/ratio and never warn about a no-op
+        from . import telemetry as _tel
+
+        if _tel.is_active():
+            _tel.count("grad_compress_collectives_total")
+            _tel.count(f"grad_compress_{op}_total")
+        return
+    raw_wire, q_wire = _compress_wire_bytes(n_elements, itemsize, block, op, n)
+    if q_wire >= raw_wire and (op, n) not in _WARNED_COUNTERPRODUCTIVE:
+        _WARNED_COUNTERPRODUCTIVE.add((op, n))
+        import warnings
+
+        warnings.warn(
+            f"grad_compress='int8' {op} over a mesh dim of {n} moves "
+            f"~{int(q_wire)} bytes on the wire vs ~{int(raw_wire)} uncompressed "
+            "(the gather-based quantized all-reduce is O(n) in wire bytes) — "
+            "compression is counterproductive here; prefer the ZeRO "
+            "reduce-scatter path or disable VESCALE_GRAD_COMPRESS",
+            stacklevel=3,
+        )
+    from . import telemetry as _tel
+
+    if not _tel.is_active():
+        return
+    _tel.count("grad_compress_collectives_total")
+    _tel.count("grad_compress_bytes_saved_total", max(0.0, raw_wire - q_wire))
+    _tel.set_gauge("grad_compress_ratio", raw_wire / q_wire if q_wire else 0.0)
+    _tel.count(f"grad_compress_{op}_total")
+
+
+def all_reduce_q(tensor, mesh: DeviceMesh, reduce_op: str = "sum", mesh_dim=0,
+                 stacked: bool = True, *, block=None, rounding=None, key=None,
+                 acc_dtype=jnp.float32):
+    """Block-scaled int8 all-reduce — the quantized ``mesh_all_reduce``.
+    Same stacked calling convention; knobs default from the registered
+    ``VESCALE_GRAD_COMPRESS_*`` env vars."""
+    block, rounding, key = _compress_defaults(block, rounding, key)
+    ax = _axis(mesh, mesh_dim)
+    n = mesh.size(mesh_dim)
+    kw = dict(block=block, rounding=rounding, key=key, acc_dtype=acc_dtype,
+              reduce_op=reduce_op)
+    if stacked:
+        f = _smap(mesh, lambda x: q_psum(jnp.squeeze(x, 0), ax, n, **kw), P(ax), P())
+        elems = int(np.prod(tensor.shape[1:]))
+    else:
+        f = _smap(mesh, lambda x: q_psum(x, ax, n, **kw), P(), P())
+        elems = int(np.prod(tensor.shape))
+    out = f(tensor)
+    _compress_telemetry(elems, jnp.dtype(tensor.dtype).itemsize, block, "all_reduce", n)
+    return out
+
+
+def reduce_scatter_q(tensor, mesh: DeviceMesh, reduce_op: str = "sum",
+                     scatter_dim: int = 0, mesh_dim=0, *, block=None,
+                     rounding=None, key=None, acc_dtype=jnp.float32):
+    """Block-scaled int8 reduce-scatter — the quantized
+    ``mesh_reduce_scatter`` (same stacked convention: input dim0 carries
+    per-rank full operands, output dim0 the per-rank reduced chunks)."""
+    block, rounding, key = _compress_defaults(block, rounding, key)
+    ax = _axis(mesh, mesh_dim)
+    n = mesh.size(mesh_dim)
+
+    def body(x):  # (1, *full)
+        x = jnp.squeeze(x, 0)
+        out = q_psum_scatter(
+            x, ax, n, scatter_dim=scatter_dim, block=block, rounding=rounding,
+            key=key, acc_dtype=acc_dtype, reduce_op=reduce_op,
+        )
+        return out[None]
+
+    out = _smap(mesh, body, P(ax), P(ax))(tensor)
+    elems = int(np.prod(tensor.shape[1:]))
+    _compress_telemetry(elems, jnp.dtype(tensor.dtype).itemsize, block, "reduce_scatter", n)
+    return out
 
 
 # ------------------------------------------------------------- cost model

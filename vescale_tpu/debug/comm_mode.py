@@ -7,16 +7,34 @@ compiler, so the ground truth is the compiled program — we lower the jitted
 function and count collective ops in the (stable)HLO.  This catches comms
 the eager interceptor can never see (GSPMD-inserted reshards), making it
 strictly more faithful on TPU.
+
+Quantized-collective attribution: the int8 gradient collectives
+(collectives.all_reduce_q / q_psum and friends) move ONE packed byte
+buffer per logical collective, with a fixed wire-dtype convention —
+REDUCTION payloads are signed ``s8``, pure MOVEMENT payloads unsigned
+``u8``.  ``count_collectives`` keys on that: an ``s8`` all-gather is the
+wire form of a quantized logical all-reduce and counts under
+``all_reduce`` with an ``all_reduce:int8`` tag (an ``s8`` all-to-all
+likewise under ``reduce_scatter``); ``u8`` collectives keep their own
+logical op with an ``:int8`` tag.  Step reports therefore stay comparable
+across compression settings instead of quantized runs showing phantom
+scatter/gather traffic.  (Within this framework only the quantized
+collectives put s8/u8 payloads on the wire.)
 """
 
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 import jax
 
-__all__ = ["comm_counts", "count_collectives", "CommDebugMode"]
+__all__ = [
+    "comm_counts",
+    "count_collectives",
+    "collective_wire_bytes",
+    "CommDebugMode",
+]
 
 # HLO/stableHLO opcodes per logical collective.  Async collectives appear
 # as op-start/op-done pairs — only the start (or sync form) is counted, so
@@ -33,14 +51,39 @@ _COLLECTIVE_OPCODES = {
     },
 }
 # applied opcodes are bare lowercase tokens immediately before '(' — operand
-# references carry a '%' prefix and never precede '(' directly
-_OPCODE_RE = re.compile(r"(?<![%\w.])([a-z][a-z0-9\-\._]*)\(")
+# references carry a '%' prefix and never precede '(' directly.  stableHLO
+# additionally quotes the opcode: `"stablehlo.all_gather"(...)`.
+_OPCODE_RE = re.compile(r'(?<![%\w.])"?([a-z][a-z0-9\-\._]*)"?\(')
+# the instruction's RESULT dtype: first type token after '=' (HLO spelling)
+_RESULT_DTYPE_RE = re.compile(r"=\s*\(?\s*([a-z][a-z0-9]*)\[")
+
+# wire-dtype convention -> logical-op remap (module docstring)
+_S8_LOGICAL = {"all_gather": "all_reduce", "all_to_all": "reduce_scatter"}
+
+
+def _line_wire_dtype(line: str) -> Optional[str]:
+    """'int8' when the collective's payload rides the quantized wire
+    convention (s8 = packed reduction, u8 = packed movement), else None."""
+    m = _RESULT_DTYPE_RE.search(line)
+    if m and m.group(1) in ("s8", "u8"):
+        return m.group(1)
+    if "stablehlo" in line:  # stablehlo spelling: tensor<...xi8> / xui8>
+        if "xui8>" in line:
+            return "u8"
+        if "xi8>" in line:
+            return "s8"
+    return None
 
 
 def count_collectives(text: str) -> Dict[str, int]:
     """Count collective ops in (stable)HLO text — the shared counter behind
     ``comm_counts`` and the telemetry step reports, so the two views agree
-    by construction on the same program."""
+    by construction on the same program.
+
+    Quantized collectives (s8/u8 payloads, module docstring) count under
+    their LOGICAL op plus a ``<op>:int8`` tag key; tag keys are extra
+    detail and excluded from ``total`` (their instructions are already
+    counted once under the logical op)."""
     out = {name: 0 for name in _COLLECTIVE_OPCODES}
     for line in text.splitlines():
         line = line.strip()
@@ -50,12 +93,138 @@ def count_collectives(text: str) -> Dict[str, int]:
             matched = False
             for name, ops in _COLLECTIVE_OPCODES.items():
                 if opcode in ops:
-                    out[name] += 1
+                    wire = _line_wire_dtype(line)
+                    if wire is not None:
+                        logical = _S8_LOGICAL.get(name, name) if wire == "s8" else name
+                        out[logical] = out.get(logical, 0) + 1
+                        tag = f"{logical}:int8"
+                        out[tag] = out.get(tag, 0) + 1
+                    else:
+                        out[name] += 1
                     matched = True
                     break
             if matched:
                 break  # one collective application per instruction line
-    out["total"] = sum(v for k, v in out.items() if k != "total")
+    out["total"] = sum(v for k, v in out.items() if k != "total" and ":" not in k)
+    return out
+
+
+# ------------------------------------------------------- wire-byte model
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+# stableHLO spelling: tensor<4x128xf32> / tensor<i8> (scalar)
+_STABLEHLO_SHAPE_RE = re.compile(r"tensor<((?:[0-9]+x)*)(u?[a-z][a-z0-9]*)>")
+_STABLEHLO_DTYPES = {
+    "i1": 1, "i8": 1, "ui8": 1, "i16": 2, "ui16": 2, "f16": 2, "bf16": 2,
+    "i32": 4, "ui32": 4, "f32": 4, "i64": 8, "ui64": 8, "f64": 8,
+}
+_GROUPS_V1_RE = re.compile(r"replica_groups=\{\{([0-9, ]*)\}")
+_GROUPS_V2_RE = re.compile(r"replica_groups=\[\s*\d+\s*,\s*(\d+)\s*\]<=")
+# stableHLO: replica_groups = dense<[[0, 1]]> : tensor<1x2xi64>
+_GROUPS_SHLO_RE = re.compile(r"replica_groups\s*=\s*dense<\[?\[([0-9, ]+)\]")
+
+
+def _group_size(line: str, default: int) -> int:
+    m = _GROUPS_V2_RE.search(line)
+    if m:
+        return max(1, int(m.group(1)))
+    m = _GROUPS_V1_RE.search(line) or _GROUPS_SHLO_RE.search(line)
+    if m:
+        ids = [t for t in m.group(1).replace(" ", "").split(",") if t]
+        return max(1, len(ids))
+    return default
+
+
+def _result_bytes(line: str, op_pos: int) -> int:
+    """Sum of the instruction's RESULT buffer bytes — HLO spelling
+    (``f32[4,128]``, the segment between '=' and the opcode) or stableHLO
+    (``tensor<4x128xf32>``, searched over the whole line since stableHLO
+    puts result types at the end).  Tuples sum their element buffers."""
+    seg = line[line.index("=") + 1 : op_pos]
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(seg):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _stablehlo_result_bytes(lines, i: int) -> int:
+    """Result bytes of the stableHLO op starting at ``lines[i]``: the type
+    signature's ``-> tensor<...>`` may sit lines below (region-bearing ops
+    like ``stablehlo.all_reduce`` close with ``}) : (...) -> tensor<...>``);
+    scanning for the arrow also skips attribute tensors (replica_groups'
+    ``dense<...> : tensor<NxMxi64>``), which are not results."""
+    for j in range(i, min(i + 200, len(lines))):
+        if "->" not in lines[j]:
+            continue
+        seg = lines[j].rsplit("->", 1)[1]
+        total = 0
+        for dims, dtype in _STABLEHLO_SHAPE_RE.findall(seg):
+            if dtype not in _STABLEHLO_DTYPES:
+                continue
+            n = 1
+            for d in dims.split("x"):
+                if d:
+                    n *= int(d)
+            total += n * _STABLEHLO_DTYPES[dtype]
+        return total
+    return 0
+
+
+def collective_wire_bytes(text: str, default_group: int = 1) -> Dict[str, float]:
+    """Per-device bytes-on-the-wire estimate from compiled HLO, using the
+    standard ring algorithmic volumes per collective (result buffer R,
+    group size n): all-reduce ``2(n-1)/n * R``, all-gather ``(n-1)/n * R``,
+    reduce-scatter ``(n-1) * R`` (its input is ``n*R``), all-to-all
+    ``(n-1)/n * R``, collective-permute ``R``.  This is the measurement
+    surface of the quantcomm bench: the payload DTYPE comes from the
+    program, so an int8-compressed reduction shows its real packed bytes.
+    Keys: logical op (quantized ops remapped per the wire convention) plus
+    ``<op>:int8`` tags; ``total`` sums the logical keys only."""
+    out: Dict[str, float] = {name: 0.0 for name in _COLLECTIVE_OPCODES}
+    lines = [l.strip() for l in text.splitlines()]
+    for i, line in enumerate(lines):
+        if line.startswith("//") or "=" not in line:
+            continue
+        for m in _OPCODE_RE.finditer(line):
+            opcode = m.group(1)
+            name = next(
+                (nm for nm, ops in _COLLECTIVE_OPCODES.items() if opcode in ops), None
+            )
+            if name is None:
+                continue
+            n = _group_size(line, default_group)
+            r = _result_bytes(line, m.start())
+            if r == 0 and "stablehlo" in line:
+                r = _stablehlo_result_bytes(lines, i)
+            f = (n - 1) / max(1, n)
+            if name == "all_reduce":
+                b = 2.0 * f * r
+            elif name == "reduce_scatter":
+                b = (n - 1) * r
+            elif name == "collective_permute":
+                b = float(r)
+            else:  # all_gather / all_to_all
+                b = f * r
+            wire = _line_wire_dtype(line)
+            if wire is not None:
+                logical = _S8_LOGICAL.get(name, name) if wire == "s8" else name
+                out[logical] = out.get(logical, 0.0) + b
+                tag = f"{logical}:int8"
+                out[tag] = out.get(tag, 0.0) + b
+            else:
+                out[name] += b
+            break  # one collective application per instruction line
+    out["total"] = sum(v for k, v in out.items() if k != "total" and ":" not in k)
     return out
 
 

@@ -1,4 +1,9 @@
 from .core import Emulator, EmulatorProcessGroup, init_process_group
 from .verify import verify_all_reduce_against_xla
 from .tuning import IciParams, choose_algorithm, calculate_chunk_size, estimate_time_us
+from .quantized import (
+    quantized_all_reduce,
+    quantized_reduce_scatter,
+    quantized_ring_report,
+)
 from . import mesh_collectives

@@ -111,13 +111,36 @@ class Emulator:
 
 class EmulatorProcessGroup:
     """Stateful pg facade (reference distributed.py:52): holds per-rank
-    buffers and executes emulated collectives in place."""
+    buffers and executes emulated collectives in place.
 
-    def __init__(self, world_size: int, algo: str = "ring"):
+    ``quantized="int8"`` switches all_reduce / reduce_scatter to the
+    block-scaled int8 replay (emulator/quantized.py) — the bitwise mirror
+    of ``collectives.all_reduce_q`` / ``reduce_scatter_q`` with matching
+    ``block``.  Bit-for-bit holds unconditionally for the deterministic
+    ``rounding="nearest"`` path; for stochastic rounding it holds only
+    when the collective was given ``key=jax.random.key(seed)`` EXPLICITLY
+    — the eager wrappers' default keys fold in a process-wide call
+    counter (``collectives.next_sr_key``) the replay cannot see."""
+
+    def __init__(
+        self,
+        world_size: int,
+        algo: str = "ring",
+        quantized: Optional[str] = None,
+        block: int = 64,
+        rounding: str = "nearest",
+        seed: Optional[int] = None,
+    ):
         if algo not in ("ring", "tree", "auto"):
             raise ValueError(f"unknown algorithm {algo!r}")
+        if quantized not in (None, "int8"):
+            raise ValueError(f"quantized must be None or 'int8', got {quantized!r}")
         self.world_size = world_size
         self.algo = algo
+        self.quantized = quantized
+        self.block = block
+        self.rounding = rounding
+        self.seed = seed
         self.emulator = Emulator(world_size)
 
     def _pick(self, tensors) -> str:
@@ -128,6 +151,12 @@ class EmulatorProcessGroup:
         return choose_algorithm(int(tensors[0].nbytes), self.world_size)
 
     def all_reduce(self, tensors: List[np.ndarray], op: str = "sum") -> List[np.ndarray]:
+        if self.quantized == "int8":
+            from .quantized import quantized_all_reduce
+
+            return quantized_all_reduce(
+                tensors, self.block, self.rounding, self.seed, reduce_op=op
+            )
         if self._pick(tensors) == "tree":
             return self.emulator.tree_all_reduce(tensors, op)
         return self.emulator.ring_all_reduce(tensors, op)
@@ -136,6 +165,12 @@ class EmulatorProcessGroup:
         return self.emulator.all_gather(tensors)
 
     def reduce_scatter(self, tensors, op: str = "sum"):
+        if self.quantized == "int8":
+            from .quantized import quantized_reduce_scatter
+
+            return quantized_reduce_scatter(
+                tensors, self.block, self.rounding, self.seed, reduce_op=op
+            )
         return self.emulator.reduce_scatter(tensors, op)
 
     def all_to_all(self, tensors):

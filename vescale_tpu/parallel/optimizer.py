@@ -245,11 +245,20 @@ class DistributedOptimizer:
         backoff_factor: float = 0.5,
         min_scale: float = 1.0,
         skip_nonfinite: Optional[bool] = None,
+        grad_compress: Optional[str] = None,
+        compress_block: Optional[int] = None,
         **_: Any,
     ):
         self.mesh = mesh
         self.dp_dims = tuple(dp_dims)
         self.param_pspecs = param_pspecs
+        # gradient compression for the explicit ZeRO grad reduction
+        # (reduce_grads): "int8" = block-scaled quantized reduce-scatter /
+        # all-reduce; None defers to VESCALE_GRAD_COMPRESS
+        from .ddp import resolve_grad_compress
+
+        self.grad_compress = resolve_grad_compress(grad_compress)
+        self.compress_block = compress_block
         self.grad_clip = grad_clip
         self.main_param_dtype = main_param_dtype
         self.loss_scale = loss_scale
@@ -311,6 +320,48 @@ class DistributedOptimizer:
     def scale_loss(self, loss, opt_state):
         """Multiply the loss by the current scale (call before ``grad``)."""
         return loss * self.current_scale(opt_state).astype(loss.dtype)
+
+    # ----------------------------------------------------- grad reduction
+    def reduce_grads(self, grads, dp_dim: Optional[str] = None):
+        """Explicit DP gradient reduction into the ZeRO layout (reference
+        distributed_optimizer.py's grad reduce-scatter) for eager /
+        explicit flows — under pure GSPMD the reduction is structural and
+        this is not needed.
+
+        DArray leaves with a Partial placement on the dp dim reduce to
+        ``Shard(0)`` when ZeRO state sharding is active and dim0 divides
+        the dp world (each rank keeps exactly the grad shard its optimizer
+        partition consumes), else to ``Replicate``.  With
+        ``grad_compress="int8"`` the wire payload is block-scaled int8
+        (quantized reduce-scatter / all-reduce); other leaves are returned
+        unchanged."""
+        from ..darray import DArray
+        from .ddp import _reduce_partial_leaf
+
+        dp_dim = dp_dim or self.dp_dims[0]
+        if self.mesh is None:
+            return grads
+        dp_index = self.mesh._dim_index(dp_dim)
+        zero_active = self.param_pspecs is not None
+        dp_world = self.mesh.size(dp_dim)
+
+        def one(g):
+            if not (isinstance(g, DArray) and g.placements[dp_index].is_partial()):
+                return g
+            from ..placements import Replicate as R, Shard as S
+
+            target = (
+                S(0)
+                if zero_active and g.shape and g.shape[0] % dp_world == 0
+                else R()
+            )
+            return _reduce_partial_leaf(
+                g, dp_index, target, self.grad_compress, self.compress_block
+            )
+
+        return jax.tree_util.tree_map(
+            one, grads, is_leaf=lambda x: isinstance(x, DArray)
+        )
 
     # -------------------------------------------------------------- step
     def step(self, params, opt_state, grads):

@@ -1,3 +1,16 @@
+from .blockscale import (
+    DEFAULT_BLOCK,
+    INT8_MAX,
+    QuantizedBlocks,
+    block_amax,
+    dequantize_int8_blocks,
+    pack_int8_payload,
+    packed_nbytes,
+    quantize_clip,
+    quantize_int8_blocks,
+    scale_from_amax,
+    unpack_int8_payload,
+)
 from .fp8 import (
     Fp8DotState,
     Fp8TensorState,
@@ -12,4 +25,15 @@ __all__ = [
     "fp8_dot",
     "init_fp8_dot_state",
     "merge_fp8_state",
+    "DEFAULT_BLOCK",
+    "INT8_MAX",
+    "QuantizedBlocks",
+    "block_amax",
+    "dequantize_int8_blocks",
+    "pack_int8_payload",
+    "packed_nbytes",
+    "quantize_clip",
+    "quantize_int8_blocks",
+    "scale_from_amax",
+    "unpack_int8_payload",
 ]

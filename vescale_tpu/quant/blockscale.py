@@ -1,0 +1,215 @@
+"""Block-scaled integer quantization — the shared scaling core.
+
+One scaling implementation for every quantized path in the framework
+(ROADMAP item 2; EQuARX, arXiv:2506.17615):
+
+  * the fp8 delayed-scaling matmul (``quant/fp8.py``) consumes
+    :func:`scale_from_amax` / :func:`quantize_clip` for its per-tensor
+    scales, and
+  * the int8 gradient collectives (``collectives.all_reduce_q`` /
+    ``reduce_scatter_q``) and the redistribution planner's
+    quantize→move→dequantize hop consume the per-BLOCK machinery here
+    (:func:`quantize_int8_blocks` / :func:`dequantize_int8_blocks` and the
+    wire-format pack/unpack).
+
+Block scaling: the flattened tensor is split into fixed-size blocks
+(default 64 elements, ``VESCALE_GRAD_COMPRESS_BLOCK``); each block gets
+its own scale from its own amax, so one outlier only costs ITS block
+precision — the per-tensor failure mode of naive int8.
+
+The scale is the smallest POWER OF TWO >= ``amax / 127`` — the OCP
+Microscaling (MX) block-format rule, stored as one E8M0 exponent byte per
+block on the wire.  Power-of-two scales are load-bearing for correctness,
+not just for the extra 3 bytes/block: ``q * 2^e`` is an EXACT f32
+exponent shift, so the dequantize multiply can be contracted into an FMA
+by any backend (XLA CPU's LLVM codegen does) without changing a single
+bit — which is what makes the collective's result deterministic across
+fusion decisions and bit-for-bit replayable by the emulator.  A
+free-mantissa scale (``amax/127`` exactly) was measured to diverge by
+1 ulp under FMA contraction.  The cost: up to 2x the rounding step of an
+ideal scale (bound ``amax/127`` per element instead of ``amax/254``).
+
+Rounding: ``"nearest"`` (IEEE round-half-to-even — deterministic, bitwise
+replayable by the emulator) or ``"stochastic"`` (``floor(x/s + u)`` with
+``u ~ U[0,1)`` from a threefry key — unbiased in expectation, seeded and
+replayable; the framework RNG's counter design means the same key gives
+the same mask on every backend).
+
+Non-finite contract (documented, tested): quantize/dequantize are traced
+jax ops, so they cannot raise on data — a block containing ANY non-finite
+element instead gets a non-finite scale, which poisons the ENTIRE block to
+NaN/Inf on dequantize.  Non-finite gradients therefore still trip
+``found_inf``/loss-scale skip logic after a quantized reduction; they are
+never silently laundered into finite values.  Host-side callers that want
+an eager error can pass ``validate=True`` (raises ``ValueError`` on
+non-finite input when called with concrete arrays).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "INT8_MAX",
+    "DEFAULT_BLOCK",
+    "scale_from_amax",
+    "pow2_scale_from_amax",
+    "quantize_clip",
+    "block_amax",
+    "QuantizedBlocks",
+    "quantize_int8_blocks",
+    "dequantize_int8_blocks",
+    "packed_nbytes",
+    "pack_int8_payload",
+    "unpack_int8_payload",
+]
+
+INT8_MAX = 127.0
+DEFAULT_BLOCK = 64
+
+
+# ---------------------------------------------------------- shared helpers
+def scale_from_amax(amax, qmax: float):
+    """``qmax / amax``: the QUANTIZE scale that puts the largest observed
+    value at the format edge; an empty/zero amax gives scale 1.0.  This is
+    the fp8 delayed-scaling rule (fp8.py) and the per-block int8 rule —
+    factored here so both formats share one definition."""
+    return jnp.where(amax > 0.0, qmax / amax, 1.0)
+
+
+def quantize_clip(x, scale, dtype, qmax: float):
+    """Scale, saturate to ±qmax, cast — the shared quantize kernel (fp8
+    uses it with a per-tensor delayed scale; int8 with per-block scales)."""
+    q = jnp.clip(x.astype(jnp.float32) * scale, -qmax, qmax)
+    return q.astype(dtype)
+
+
+def block_amax(x, block: int = DEFAULT_BLOCK):
+    """Per-block max|x| of the flattened input, fp32, shape ``(n_blocks,)``
+    (zero-padded tail).  NaN propagates (jnp.max) — see the non-finite
+    contract in the module docstring."""
+    blocks = _to_blocks(x, block)
+    return jnp.max(jnp.abs(blocks), axis=1)
+
+
+def _to_blocks(x, block: int):
+    flat = jnp.ravel(x).astype(jnp.float32)
+    n = flat.size
+    nb = -(-n // block) if n else 1
+    flat = jnp.pad(flat, (0, nb * block - n))
+    return flat.reshape(nb, block)
+
+
+# ------------------------------------------------------------- int8 blocks
+class QuantizedBlocks(NamedTuple):
+    """A block-quantized tensor: int8 codes + per-block power-of-two
+    dequantize scales (``value ≈ q * scales[block]``; each scale is
+    exactly ``2^e`` and travels as one E8M0 exponent byte)."""
+
+    q: jax.Array       # (n_blocks, block) int8, zero-padded tail
+    scales: jax.Array  # (n_blocks,) fp32, each exactly a power of two
+
+
+def pow2_scale_from_amax(amax):
+    """The smallest power of two >= ``amax / 127`` (MX/E8M0 rule), as an
+    exact-f32 dequantize multiplier.  Zero amax gets the rule applied to a
+    placeholder amax of 1.0 (scale ``2^-6``; all codes are zero, so the
+    block round-trips exactly regardless); non-finite amax -> +inf (the
+    block-poisoning contract).  Pure bit manipulation — ceil on the
+    exponent field — so eager and compiled execution agree bitwise."""
+    target = jnp.where(amax > 0.0, amax, 1.0).astype(jnp.float32) * jnp.float32(
+        1.0 / INT8_MAX
+    )
+    bits = jax.lax.bitcast_convert_type(target, jnp.int32)
+    exp = (bits >> 23) & 0xFF
+    mant = bits & 0x7FFFFF
+    # ceil to the next power of two; clamp to the normal range so the
+    # reciprocal stays finite, and force the infinity encoding (e=255)
+    # for non-finite amax
+    e = jnp.clip(exp + (mant != 0).astype(jnp.int32), 1, 254)
+    e = jnp.where(jnp.isfinite(amax), e, 255)
+    return jax.lax.bitcast_convert_type((e << 23).astype(jnp.int32), jnp.float32)
+
+
+def quantize_int8_blocks(
+    x,
+    block: int = DEFAULT_BLOCK,
+    rounding: str = "nearest",
+    key: Optional[jax.Array] = None,
+    validate: bool = False,
+) -> QuantizedBlocks:
+    """Quantize ``x`` to block-scaled int8.
+
+    Round-trip bound (tested): with ``rounding="nearest"``,
+    ``|x - dequantize(quantize(x))| <= amax_block / 127`` elementwise (the
+    power-of-two scale is at most 2x the ideal ``amax/127`` step);
+    stochastic rounding doubles the per-element bound but is unbiased in
+    expectation.  All-zero blocks round-trip exactly; non-finite blocks
+    poison to non-finite (module docstring contract)."""
+    if rounding not in ("nearest", "stochastic"):
+        raise ValueError(f"rounding must be 'nearest' or 'stochastic', got {rounding!r}")
+    if rounding == "stochastic" and key is None:
+        raise ValueError("stochastic rounding needs an explicit PRNG key")
+    if validate:
+        concrete = not isinstance(x, jax.core.Tracer)
+        if concrete and not bool(jnp.all(jnp.isfinite(x))):
+            raise ValueError("quantize_int8_blocks(validate=True): non-finite input")
+    blocks = _to_blocks(x, block)
+    amax = jnp.max(jnp.abs(blocks), axis=1)
+    # exact power-of-two dequantize multiplier; non-finite amax -> inf so
+    # the whole block dequantizes non-finite (0 * inf = nan) instead of
+    # silently wrong
+    scales = pow2_scale_from_amax(amax)
+    v = blocks * (1.0 / scales)[:, None]  # exact: reciprocal of 2^e
+    if rounding == "nearest":
+        q = jnp.round(v)  # half-to-even: bitwise replayable host-side
+    else:
+        u = jax.random.uniform(key, blocks.shape, jnp.float32)
+        q = jnp.floor(v + u)
+    q = jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return QuantizedBlocks(q, scales)
+
+
+def dequantize_int8_blocks(qb: QuantizedBlocks, shape, dtype, acc_dtype=jnp.float32):
+    """Reconstruct the tensor: ``q * scale`` per block in ``acc_dtype``,
+    trimmed to ``shape`` and cast to ``dtype``."""
+    full = qb.q.astype(acc_dtype) * qb.scales.astype(acc_dtype)[:, None]
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return full.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+# ------------------------------------------------------------- wire format
+def packed_nbytes(n_elements: int, block: int = DEFAULT_BLOCK) -> int:
+    """Bytes of the packed int8 payload for ``n_elements``: one byte per
+    (padded) element plus ONE E8M0 exponent byte per block — the quantity
+    the byte-savings telemetry and the planner cost model charge."""
+    nb = -(-max(1, n_elements) // block)
+    return nb * block + nb
+
+
+def pack_int8_payload(qb: QuantizedBlocks) -> jax.Array:
+    """One flat int8 buffer ``[codes | E8M0 scale bytes]`` — a quantized
+    collective moves a SINGLE int8 array on the wire (payload and scales
+    together), so comm accounting sees exactly one s8 op and the scales
+    cannot be reordered relative to their codes.  Each power-of-two scale
+    packs to its f32 exponent byte (E8M0: ``2^(e-127)``; 255 = the
+    non-finite poison marker)."""
+    bits = jax.lax.bitcast_convert_type(qb.scales, jnp.int32)
+    e = ((bits >> 23) & 0xFF).astype(jnp.uint8)
+    return jnp.concatenate(
+        [qb.q.reshape(-1), jax.lax.bitcast_convert_type(e, jnp.int8)]
+    )
+
+
+def unpack_int8_payload(buf, n_blocks: int, block: int) -> QuantizedBlocks:
+    q = buf[: n_blocks * block].reshape(n_blocks, block)
+    e = jax.lax.bitcast_convert_type(buf[n_blocks * block :], jnp.uint8)
+    scales = jax.lax.bitcast_convert_type(
+        (e.astype(jnp.int32) << 23), jnp.float32
+    )
+    return QuantizedBlocks(q, scales)

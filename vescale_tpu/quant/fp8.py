@@ -45,6 +45,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .blockscale import quantize_clip, scale_from_amax
+
 __all__ = [
     "Fp8TensorState",
     "Fp8DotState",
@@ -77,9 +79,9 @@ def init_fp8_dot_state(history_len: int = 16) -> Fp8DotState:
 def _delayed_scale(st: Fp8TensorState, fp8_max: float) -> jax.Array:
     """fp8_max / max(history): the scale that would have put the largest
     recent value at the format edge.  Empty history (all zeros — the first
-    steps) -> scale 1.0."""
-    amax = jnp.max(st.amax_history)
-    return jnp.where(amax > 0.0, fp8_max / amax, 1.0)
+    steps) -> scale 1.0.  (scale-from-amax rule shared with the int8 block
+    quantizer — quant/blockscale.py.)"""
+    return scale_from_amax(jnp.max(st.amax_history), fp8_max)
 
 
 def _roll(st: Fp8TensorState, amax_now: jax.Array) -> Fp8TensorState:
@@ -89,9 +91,8 @@ def _roll(st: Fp8TensorState, amax_now: jax.Array) -> Fp8TensorState:
     return Fp8TensorState(jnp.concatenate([amax_now[None], st.amax_history[:-1]]))
 
 
-def _quantize(x, scale, dtype, fp8_max: float):
-    q = jnp.clip(x.astype(jnp.float32) * scale, -fp8_max, fp8_max)
-    return q.astype(dtype)
+# scale + saturate + cast: the shared quantize kernel (blockscale.py)
+_quantize = quantize_clip
 
 
 @jax.custom_vjp

@@ -44,6 +44,23 @@ def redistribute(darr: DArray, placements, mesh: Optional[DeviceMesh] = None) ->
     if dst == src:
         return darr
 
+    # Gated LOSSY int8 overlay (VESCALE_REDISTRIBUTE_QUANT, default off):
+    # before the exact tiers, let a quantize->move->dequantize hop compete
+    # on the planner's cost model — taken only where packed int8 payloads
+    # beat the unquantized wire pattern; otherwise a structured VSC127
+    # decline is recorded (redistribute_plan.quant_decline_finding), never
+    # a silent fallback.  Multi-hop composite pairs get the same edge via
+    # the planner's lattice search below.
+    if dst_mesh == darr.mesh:
+        from .analysis import envreg
+
+        if envreg.get_bool("VESCALE_REDISTRIBUTE_QUANT"):
+            from .redistribute_plan import quant_single_hop_plan
+
+            qplan = quant_single_hop_plan(src, dst)
+            if qplan is not None:
+                return DArray(qplan.execute(darr.data), dst)
+
     # Fast path: same mesh, no partial/ragged/interleave on either side —
     # the physical array is the logical array; let XLA/jax reshard directly
     # without a pack/unpack round-trip.

@@ -62,6 +62,9 @@ __all__ = [
     "plan_redistribute",
     "decline_reason",
     "decline_finding",
+    "quant_single_hop_plan",
+    "quant_outcome",
+    "quant_decline_finding",
     "plan_comm_summary",
     "can_redistribute_per_shard",
     "clear_plan_cache",
@@ -106,6 +109,23 @@ _WEIGHTS = {
 # fewer hops win — each hop is a separate dispatch + collective launch
 _HOP_LATENCY = 64 * 1024
 
+# quantized (int8) hop pricing: the tagged logical collectives of
+# transfer.quant_plan_info map onto the wire PATTERN they actually execute
+# (quantized all-reduce gathers packed payloads; quantized reduce-scatter
+# is an all-to-all exchange), and the quantize/dequantize elementwise
+# passes are charged at one cost unit per tensor byte they touch — so a
+# quantized hop wins only when the ~4x payload shrink beats the compute it
+# adds: DP-grade grad reductions on small mesh dims win, big-fan-in
+# reductions (the gather-based algorithm is O(n) wire AND O(n) dequant)
+# and pure layout moves decline.
+_QWEIGHTS = {
+    "all_reduce:int8": 4.0,      # gather pattern
+    "all_gather:int8": 4.0,
+    "reduce_scatter:int8": 1.0,  # all-to-all pattern
+    "all_to_all:int8": 1.0,
+}
+_QUANT_COMPUTE_WEIGHT = 1.0  # cost units per tensor byte quantized/dequantized
+
 
 def _mem_factor() -> float:
     return envreg.get_float("VESCALE_REDISTRIBUTE_MEM_FACTOR")
@@ -115,17 +135,33 @@ def _max_hops() -> int:
     return envreg.get_int("VESCALE_REDISTRIBUTE_MAX_HOPS")
 
 
+def _quant_sig():
+    """The quant-hop knob tuple, part of every cache key (None = gate off):
+    flipping VESCALE_REDISTRIBUTE_QUANT or a compression knob must
+    re-search, not re-serve a cached plan built under other settings."""
+    if not envreg.get_bool("VESCALE_REDISTRIBUTE_QUANT"):
+        return None
+    from .quant.blockscale import DEFAULT_BLOCK
+
+    block = envreg.get_int("VESCALE_GRAD_COMPRESS_BLOCK") or DEFAULT_BLOCK
+    rounding = "stochastic" if envreg.get_bool("VESCALE_GRAD_COMPRESS_SR") else "nearest"
+    seed = envreg.get_int("VESCALE_GRAD_COMPRESS_SEED") or 0
+    return (int(block), rounding, int(seed))
+
+
 @dataclasses.dataclass
 class PlanHop:
     """One primitive per-shard move of a multi-hop plan."""
 
-    kind: str  # "dense" | "ragged" | "interleaved" | "reshard" | "device_put"
+    kind: str  # "dense" | "ragged" | "interleaved" | "reshard" | "device_put" | "quant"
     src: DArraySpec
     dst: DArraySpec
     fn: object  # physical(src) -> physical(dst); None for reshard/device_put
     collectives: Dict[str, int]  # expected collective kinds (static view)
     bytes_moved: int  # per-device bytes on the wire (cost-model estimate)
     cost: float
+    bytes_raw: int = 0  # unquantized bytes the same wire ops would move
+    #                     (quant hops only; feeds grad_compress_bytes_saved)
 
     def apply(self, x):
         if self.kind == "reshard":
@@ -165,6 +201,13 @@ class RedistributePlan:
             _tel.count("redistribute.hops", len(self.hops))
             _tel.count("redistribute.bytes_moved_total", summary["bytes_moved"])
             _tel.set_gauge("redistribute.bytes_moved", summary["bytes_moved"])
+            qhops = [h for h in self.hops if h.kind == "quant"]
+            if qhops:
+                _tel.count("redistribute.quant_hops", len(qhops))
+                _tel.count(
+                    "grad_compress_bytes_saved_total",
+                    sum(max(0, h.bytes_raw - h.bytes_moved) for h in qhops),
+                )
         return x
 
 
@@ -288,11 +331,57 @@ def _reshard_edge(src: DArraySpec, dst: DArraySpec) -> Optional[PlanHop]:
     )
 
 
+def _quant_edge(src: DArraySpec, dst: DArraySpec, build: bool) -> Optional[PlanHop]:
+    """The LOSSY quantize->move->dequantize hop (gated by
+    VESCALE_REDISTRIBUTE_QUANT): the same static plan as the dense edge,
+    but every wire collective carries a block-scaled int8 payload
+    (transfer.quant_transition_fn).  Cost charges the packed bytes at the
+    wire pattern's weight plus a quantize/dequantize compute term on the
+    raw bytes — the hop competes with the dense edge and is taken only
+    where it wins."""
+    sig = _quant_sig()
+    if sig is None:
+        return None
+    from .transfer import quant_plan_info, quant_transition_fn
+
+    block, rounding, _seed = sig
+    info = quant_plan_info(src, dst, block)
+    if info is None:
+        return None
+    _ops, colls, q_bytes, raw_bytes, compute_bytes, wire_detail = info
+    cost = _QUANT_COMPUTE_WEIGHT * compute_bytes
+    for tag, q_op_bytes in wire_detail:  # each op's OWN bytes at its weight
+        cost += _QWEIGHTS[tag] * q_op_bytes
+    fn = None
+    if build:
+        base = quant_transition_fn(src, dst, block, rounding)
+        if rounding == "stochastic":
+            # the key is a RUNTIME argument of the cached kernel: each
+            # execution draws fresh (replayable) noise instead of reusing
+            # one baked mask forever
+            from .collectives import next_sr_key
+
+            def fn(x, _base=base):
+                return _base(x, next_sr_key())
+        else:
+            fn = base
+    return PlanHop(
+        "quant", src, dst, fn, colls, int(q_bytes), cost + _HOP_LATENCY, int(raw_bytes)
+    )
+
+
 def _edge(src: DArraySpec, dst: DArraySpec, build: bool = False) -> Optional[PlanHop]:
-    """The cheapest feasible primitive hop src -> dst, or None."""
+    """The cheapest feasible primitive hop src -> dst, or None.  With the
+    quant gate on, the quantized variant competes with the dense edge on
+    cost; every other kind keeps its priority order."""
+    dense = _dense_edge(src, dst, build)
+    quant = _quant_edge(src, dst, build)
+    if dense is not None and quant is not None:
+        return quant if quant.cost < dense.cost else dense
+    if dense is not None or quant is not None:
+        return dense if dense is not None else quant
     return (
-        _dense_edge(src, dst, build)
-        or _ragged_edge(src, dst, build)
+        _ragged_edge(src, dst, build)
         or _interleaved_edge(src, dst, build)
         or _reshard_edge(src, dst)
     )
@@ -495,6 +584,33 @@ class _LRU:
 
 _PLANS = _LRU(512)
 _DECLINES = _LRU(512)  # (src, dst, knobs) -> Decline
+_QUANT_DECLINES = _LRU(512)  # (src, dst, knobs) -> Decline (VSC127)
+
+
+def _record_quant_outcome(key, src: DArraySpec, dst: DArraySpec, plan) -> None:
+    """With the quant gate ON, every planned pair gets a structured
+    outcome: either the plan carries a quant hop, or a ``VSC127`` decline
+    names WHY the quantized route was not taken (no silent fallback —
+    the acceptance contract of the quant-hop feature)."""
+    if any(h.kind == "quant" for h in (plan.hops if plan is not None else ())):
+        return
+    q = _quant_edge(src, dst, build=False)
+    if q is None:
+        reason = (
+            "no quantizable wire plan for this pair (non-float dtype, "
+            "non-sum/avg reduction, ragged/interleaved layout, or no wire op)"
+        )
+    else:
+        d = _dense_edge(src, dst, build=False)
+        if d is not None and d.cost <= q.cost:
+            reason = (
+                f"cost model: quantized hop costs {q.cost:.3g} vs {d.cost:.3g} "
+                "unquantized (packed bytes + quantize/dequantize compute do "
+                "not beat the dense wire pattern here)"
+            )
+        else:
+            reason = "cost model prefers an unquantized multi-hop route"
+    _QUANT_DECLINES.put(key, Decline("VSC127", reason))
 
 
 def plan_redistribute(src: DArraySpec, dst: DArraySpec) -> Optional[RedistributePlan]:
@@ -505,8 +621,8 @@ def plan_redistribute(src: DArraySpec, dst: DArraySpec) -> Optional[Redistribute
 
     # the knobs are part of the key: raising VESCALE_REDISTRIBUTE_MEM_FACTOR
     # after a budget decline (as the fallback warning instructs) must
-    # re-search, not re-serve the cached decline
-    key = (src, dst, _mem_factor(), _max_hops())
+    # re-search, not re-serve the cached decline — same for the quant gate
+    key = (src, dst, _mem_factor(), _max_hops(), _quant_sig())
     plan = _PLANS.get(key)
     if plan is not None:
         _tel.count("redistribute.plan_hits")
@@ -520,6 +636,8 @@ def plan_redistribute(src: DArraySpec, dst: DArraySpec) -> Optional[Redistribute
     else:
         hops, reason = _search_same_mesh(src, dst)
         plan = RedistributePlan(src, dst, _materialize(hops)) if hops is not None else None
+    if _quant_sig() is not None:
+        _record_quant_outcome(key, src, dst, plan)
     if plan is None:
         _DECLINES.put(key, reason or Decline("VSC121", "unknown"))
         return None
@@ -533,8 +651,69 @@ _NOT_CONSULTED = Decline("VSC126", "planner was not consulted for this pair")
 def decline_finding(src: DArraySpec, dst: DArraySpec) -> Decline:
     """The structured decline for (src, dst): a ``VSC12x``-coded
     :class:`Decline` (VSC126 when the planner never saw the pair)."""
-    d = _DECLINES.get((src, dst, _mem_factor(), _max_hops()))
+    d = _DECLINES.get((src, dst, _mem_factor(), _max_hops(), _quant_sig()))
     return d if d is not None else _NOT_CONSULTED
+
+
+def quant_single_hop_plan(src: DArraySpec, dst: DArraySpec) -> Optional[RedistributePlan]:
+    """The gated quantized overlay for SINGLE-hop transitions: tiers 1-2 of
+    ``redistribute()`` never reach the planner, so with
+    ``VESCALE_REDISTRIBUTE_QUANT`` on the dispatch consults this first —
+    a one-hop quantized plan when the cost model says int8 packing beats
+    the unquantized kernel for this pair, else None with a ``VSC127``
+    decline recorded (``quant_decline_finding``).  Memoized in the same
+    plan cache, so repeats pay zero re-planning/retracing and
+    ``execute()`` feeds the same telemetry counters as every plan."""
+    sig = _quant_sig()
+    if sig is None or src.mesh != dst.mesh or src == dst:
+        return None
+    key = (src, dst, _mem_factor(), _max_hops(), sig)
+    plan = _PLANS.get(key)
+    if plan is not None:
+        from . import telemetry as _tel
+
+        _tel.count("redistribute.plan_hits")
+        return plan if any(h.kind == "quant" for h in plan.hops) else None
+    if key in _QUANT_DECLINES:
+        return None
+    q = _quant_edge(src, dst, build=False)
+    d = _dense_edge(src, dst, build=False)
+    if q is not None and (d is None or q.cost < d.cost):
+        plan = RedistributePlan(src, dst, (_quant_edge(src, dst, build=True),))
+        _PLANS.put(key, plan)
+        return plan
+    _record_quant_outcome(key, src, dst, None)
+    return None
+
+
+def quant_outcome(src: DArraySpec, dst: DArraySpec):
+    """Analysis-side view of the quant-hop decision for one pair WITHOUT
+    building kernels: ``("taken", PlanHop)`` when the cost model picks the
+    quantized hop, ``("declined", Decline)`` otherwise, or None when the
+    gate is off / meshes differ.  shardcheck's ``check_transition``
+    renders this as VSC128 / VSC127 findings."""
+    sig = _quant_sig()
+    if sig is None or src.mesh != dst.mesh or src == dst:
+        return None
+    q = _quant_edge(src, dst, build=False)
+    d = _dense_edge(src, dst, build=False)
+    if q is not None and (d is None or q.cost < d.cost):
+        return ("taken", q)
+    key = (src, dst, _mem_factor(), _max_hops(), sig)
+    _record_quant_outcome(key, src, dst, None)
+    return ("declined", _QUANT_DECLINES.get(key))
+
+
+def quant_decline_finding(src: DArraySpec, dst: DArraySpec) -> Optional[Decline]:
+    """Why the QUANTIZED hop was not taken for a planned (src, dst) under
+    the current knobs: a ``VSC127`` :class:`Decline`, or None when the gate
+    is off, the pair was never planned, or the plan DID take a quant hop.
+    Surfaced through shardcheck's ``check_transition`` like every other
+    planner outcome."""
+    sig = _quant_sig()
+    if sig is None:
+        return None
+    return _QUANT_DECLINES.get((src, dst, _mem_factor(), _max_hops(), sig))
 
 
 def decline_reason(src: DArraySpec, dst: DArraySpec) -> str:
@@ -558,7 +737,12 @@ def can_redistribute_per_shard(src: DArraySpec, dst: DArraySpec) -> bool:
 def clear_plan_cache() -> None:
     _PLANS.clear()
     _DECLINES.clear()
+    _QUANT_DECLINES.clear()
 
 
 def plan_cache_stats() -> Dict[str, int]:
-    return {"plans": len(_PLANS), "declines": len(_DECLINES)}
+    return {
+        "plans": len(_PLANS),
+        "declines": len(_DECLINES),
+        "quant_declines": len(_QUANT_DECLINES),
+    }

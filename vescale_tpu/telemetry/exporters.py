@@ -149,15 +149,20 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     # consistency_* (cross-rank desync checks) lives in the resilience
     # block: one recovery-story section, not two
     _res = ("resilience_", "consistency_")
+    # quantized gradient collectives + planner quant hops: one
+    # grad-compression story (collectives._compress_telemetry feed)
+    _qc = ("grad_compress_", "redistribute.quant")
     res_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_res)}
+    qc_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_qc)}
     other_gauges = {
         n: v
         for n, v in snap["gauges"].items()
-        if not n.startswith(("mem_",) + _res)
+        if not n.startswith(("mem_",) + _res + _qc)
     }
     res_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_res)}
+    qc_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_qc)}
     other_counters = {
-        n: v for n, v in snap["counters"].items() if not n.startswith(_res)
+        n: v for n, v in snap["counters"].items() if not n.startswith(_res + _qc)
     }
     if other_counters:
         lines.append("counters:")
@@ -167,6 +172,16 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
         lines.append("gauges:")
         for name in sorted(other_gauges):
             lines.append(f"  {name:<48} {other_gauges[name]:>12.6g}")
+    if qc_counters or qc_gauges:
+        # byte-savings block of the quantized collectives: bytes-saved
+        # totals humanize; the compress-ratio gauge stays numeric
+        lines.append("grad-compression:")
+        for name in sorted(qc_counters):
+            v = qc_counters[name]
+            shown = _fmt_bytes(v) if "bytes" in name else _fmt(v)
+            lines.append(f"  {name:<48} {shown:>16}")
+        for name in sorted(qc_gauges):
+            lines.append(f"  {name:<48} {qc_gauges[name]:>12.6g}")
     if res_counters or res_gauges:
         # recovery-event block (resilience/loop.py feed, mirrors memory:):
         # a zero-fault run shows armed-but-quiet counters at 0

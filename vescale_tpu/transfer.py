@@ -61,6 +61,8 @@ __all__ = [
     "fallback_fn",
     "ragged_transition_fn",
     "interleaved_transition_fn",
+    "quant_plan_info",
+    "quant_transition_fn",
 ]
 
 
@@ -645,3 +647,183 @@ def fallback_fn(src: DArraySpec, dst: DArraySpec):
     if src.mesh == dst.mesh:
         return jax.jit(go, out_shardings=dst.named_sharding())
     return go  # cross-mesh: device sets differ; stay eager
+
+
+# --------------------------------------------- quantized transition kernel
+# The quantize->move->dequantize variant of ``transition_fn``: every WIRE op
+# of the static plan routes through the block-scaled int8 collectives
+# (collectives.q_psum / q_all_gather / q_psum_scatter / q_all_to_all), so
+# the payload on the wire is one packed int8 buffer per collective while
+# local ops (slice / seed) stay exact.  LOSSY by construction — only the
+# redistribution planner's gated quant hop (VESCALE_REDISTRIBUTE_QUANT)
+# and the grad-compression knobs on DDP / DistributedOptimizer build these.
+
+_Q_DTYPES = ("float32", "bfloat16", "float16")
+_Q_WIRE = {"reduce", "reduce_scatter", "gather", "move"}
+
+
+def quant_plan_info(src: DArraySpec, dst: DArraySpec, block: int = 64):
+    """Static feasibility + byte accounting for a quantized transition.
+
+    Returns ``(ops, collectives, q_bytes, raw_bytes, compute_bytes,
+    wire_detail)`` or ``None`` when the pair has no quantizable plan:
+    ``collectives`` maps tagged logical ops (``all_reduce:int8`` ...) to
+    counts, ``q_bytes`` is the per-device packed payload estimate the
+    planner's cost model charges on the wire, ``raw_bytes`` the
+    unquantized payload the same wire ops would move, ``compute_bytes``
+    the tensor bytes the quantize/dequantize elementwise passes touch, and
+    ``wire_detail`` a per-wire-op ``(tag, q_bytes_op)`` list so the cost
+    model can weight each op's OWN bytes (not an average).  Quantized
+    all-reduce is gather-based (quantize ONCE, no per-hop requantization),
+    so both its wire bytes and its dequantize-accumulate compute scale
+    with the mesh-dim size — the cost model sees that honestly and
+    declines where a ring psum is cheaper (large mesh dims)."""
+    from .quant.blockscale import packed_nbytes
+
+    if str(jnp.dtype(src.dtype)) not in _Q_DTYPES:
+        return None
+    ops = _plan_ops(src, dst)
+    if ops is None:
+        return None
+    wire = [op for op in ops if op[0] in _Q_WIRE]
+    if not wire:
+        return None
+    itemsize = jnp.dtype(src.dtype).itemsize
+    sb, db = src.per_shard_bytes(), dst.per_shard_bytes()
+    colls: Dict[str, int] = {}
+    q_bytes = 0.0
+    raw_bytes = 0.0
+    compute_bytes = 0.0
+    wire_detail: List[Tuple[str, int]] = []
+    for op in wire:
+        kind, i = op[0], op[1]
+        n = src.mesh.shape[i]
+        f = (n - 1) / max(1, n)
+        if kind == "reduce":
+            if op[2] not in ("sum", "avg"):
+                return None
+            # gather-based quantized all-reduce: each device receives n-1
+            # packed contributions of its full shard and dequantize-adds
+            # all n of them
+            elems = sb // itemsize
+            q, r, c = f * n * packed_nbytes(int(elems), block), 2 * f * sb, "all_reduce:int8"
+            comp = (1 + n) * sb
+        elif kind == "reduce_scatter":
+            if op[2] not in ("sum", "avg"):
+                return None
+            elems = sb // itemsize
+            q, r, c = f * packed_nbytes(int(elems), block), f * sb, "reduce_scatter:int8"
+            comp = 2 * sb  # quantize full operand + dequant n chunks of sb/n
+        elif kind == "gather":
+            elems = db // itemsize
+            q, r, c = f * packed_nbytes(int(elems), block), f * db, "all_gather:int8"
+            comp = db // max(1, n) + db  # quantize own chunk, dequant all n
+        else:  # move
+            elems = max(sb, db) // itemsize
+            q, r, c = f * packed_nbytes(int(elems), block), f * max(sb, db), "all_to_all:int8"
+            comp = 2 * max(sb, db)
+        colls[c] = colls.get(c, 0) + 1
+        q_bytes += q
+        raw_bytes += r
+        compute_bytes += comp
+        wire_detail.append((c, int(q)))
+    return ops, colls, int(q_bytes), int(raw_bytes), int(compute_bytes), wire_detail
+
+
+@functools.lru_cache(maxsize=128)
+def quant_transition_fn(
+    src: DArraySpec,
+    dst: DArraySpec,
+    block: int = 64,
+    rounding: str = "nearest",
+):
+    """A compiled ``physical(src) -> physical(dst)`` transition whose wire
+    collectives carry block-scaled int8 payloads, or None when the pair
+    has no quantizable plan (see ``quant_plan_info``).
+
+    The nearest-rounding kernel is unary; the stochastic kernel takes
+    ``(x, key)`` — the key is a RUNTIME argument, never baked into the
+    cached compilation, so every call can draw fresh noise
+    (``collectives.next_sr_key``) without retracing."""
+    from .collectives import q_all_gather, q_all_to_all, q_psum, q_psum_scatter
+
+    info = quant_plan_info(src, dst, block)
+    if info is None:
+        return None
+    ops = info[0]
+    mesh = src.mesh
+    name = mesh.dim_name
+    src_lead = src.layout().partial_mesh_dims
+    dst_lead = dst.layout().partial_mesh_dims
+    ext = dict(enumerate(src.shape))
+    qkw = dict(block=block, rounding=rounding)
+
+    def worker(x, base_key=None):
+        if src_lead:
+            x = jnp.squeeze(x, axis=tuple(range(len(src_lead))))
+        for op_idx, op in enumerate(ops):
+            kind = op[0]
+            # each wire op folds its ordinal into the SR key: two ops of
+            # one plan must not share a noise mask (ranks whose indices
+            # coincide across mesh dims would correlate their errors)
+            key = None if base_key is None else jax.random.fold_in(base_key, op_idx)
+            if kind == "reduce":
+                _, i, rop = op
+                x = q_psum(x, name(i), mesh.shape[i], key=key, reduce_op=rop, **qkw)
+            elif kind == "reduce_scatter":
+                _, i, rop, d = op
+                n = mesh.shape[i]
+                x = _pad_to(x, d, _chunk_of(dst, d) * n)
+                x = q_psum_scatter(
+                    x, name(i), n, scatter_dim=d, key=key, reduce_op=rop, **qkw
+                )
+            elif kind == "gather":
+                _, i, d = op
+                x = q_all_gather(
+                    x, name(i), mesh.shape[i], axis=d, extent=ext[d], key=key, **qkw
+                )
+            elif kind == "move":
+                _, i, d, d2 = op
+                n = mesh.shape[i]
+                x = _pad_to(x, d2, _chunk_of(dst, d2) * n)
+                x = q_all_to_all(
+                    x, name(i), n, split_axis=d2, concat_axis=d, key=key, **qkw
+                )
+                x = _trim_to(x, d, ext[d])
+            elif kind == "slice":
+                _, i, d = op
+                n = mesh.shape[i]
+                chunk = _chunk_of(dst, d)
+                x = _pad_to(x, d, chunk * n)
+                idx = jax.lax.axis_index(name(i))
+                x = jax.lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=d)
+            elif kind == "seed":
+                _, i, rop = op
+                if rop == "sum":
+                    idx = jax.lax.axis_index(name(i))
+                    x = jnp.where(idx == 0, x, jnp.zeros_like(x))
+        if dst_lead:
+            x = jnp.expand_dims(x, axis=tuple(range(len(dst_lead))))
+        return x
+
+    if rounding == "stochastic":
+        from jax.sharding import PartitionSpec as _P
+
+        fn = shard_map(
+            worker,
+            mesh=mesh.jax_mesh,
+            in_specs=(src.layout().pspec, _P()),  # key replicated
+            out_specs=dst.layout().pspec,
+            check_vma=False,
+            axis_names=frozenset(mesh.mesh_dim_names),
+        )
+        return jax.jit(fn)
+    fn = shard_map(
+        lambda x: worker(x),
+        mesh=mesh.jax_mesh,
+        in_specs=(src.layout().pspec,),
+        out_specs=dst.layout().pspec,
+        check_vma=False,
+        axis_names=frozenset(mesh.mesh_dim_names),
+    )
+    return jax.jit(fn)
