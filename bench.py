@@ -67,6 +67,23 @@ def _step_report_line(step, params, opt_state, batch, on_tpu):
         return None
 
 
+def _cost_model_line():
+    """Which cost model is pricing planner/scheduler decisions during this
+    bench: the active calibration table's digest (so a future reader of
+    BENCH_*.json knows WHICH measured table stood behind a perf line), or
+    'analytic'.  Never fails the bench."""
+    try:
+        from vescale_tpu.telemetry import calibrate
+
+        digest = calibrate.active_digest()
+        if digest is not None:
+            return {"kind": "calibrated", "calibration_digest": digest}
+        return {"kind": "analytic"}
+    except Exception as e:
+        print(f"[bench] cost-model probe failed (non-fatal): {e!r}", file=sys.stderr)
+        return {"kind": "analytic"}
+
+
 def time_and_report(step, params, opt_state, batch, *, n, tokens_per_step,
                     flops_per_token, metric, on_tpu, extra=None):
     """Warmup + timed loop + one JSON line (shared by every bench rung).
@@ -95,6 +112,7 @@ def time_and_report(step, params, opt_state, batch, *, n, tokens_per_step,
     }
     if step_report is not None:
         line["step_report"] = step_report
+    line["cost_model"] = _cost_model_line()
     line.update(extra or {})
     print(json.dumps(line))
     return mfu
@@ -327,6 +345,100 @@ def bench_memtrack():
         "step_ms_base": round(base * 1e3, 3),
         "step_ms_memtrack": round(tracked * 1e3, 3),
         "live_arrays": live,
+    }))
+
+
+def bench_trace():
+    """Trace-overhead rung (VESCALE_BENCH=trace): the SAME compiled step
+    timed bare vs with the ndtimeline profiler live — a TRAIN_STEP span per
+    step into the ring buffer, drained to a LocalRawHandler at a 50-step
+    flush cadence (the production tracing configuration; a PER-STEP file
+    flush costs ~80 us of pure IO and belongs to interactive debugging, not
+    an always-on profile).  The reported delta is the per-step cost of
+    leaving tracing on.  Acceptance bar from ISSUE 9: < 1%/step."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from vescale_tpu.dmodule import parallelize_module
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.models.llama import Llama, LlamaConfig, llama_plan
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
+    from vescale_tpu.ndtimeline import LocalRawHandler
+    from vescale_tpu.ndtimeline.api import flush, init_ndtimers
+    from vescale_tpu.parallel.optimizer import DistributedOptimizer
+    from vescale_tpu.train import make_train_step
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    B, T = (4, 1024) if on_tpu else (2, 64)
+    cfg = LlamaConfig(
+        vocab_size=2048 if on_tpu else 128,
+        hidden_size=256 if on_tpu else 32,
+        intermediate_size=512 if on_tpu else 64,
+        num_hidden_layers=4 if on_tpu else 2,
+        num_attention_heads=4 if on_tpu else 2,
+        num_key_value_heads=4 if on_tpu else 2,
+        max_position_embeddings=T,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    mesh = DeviceMesh(("dp", "tp"), (1, 1), devices=devices[:1])
+    dm = parallelize_module(Llama(cfg), mesh, llama_plan(mesh, sequence_parallel=False))
+    params = dm.init(jax.random.key(0), jnp.ones((2, T), jnp.int32))["params"]
+    dopt = DistributedOptimizer(optax.adamw(1e-3))
+    opt_state = dopt.init(params)
+    step = make_train_step(
+        dm, dopt, lambda lg, b: cross_entropy_loss(lg, b["target"]), donate=False
+    )
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T + 1)), jnp.int32)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+    # CPU steps are ~1 ms: deep median to resolve a <1% delta (resilience
+    # rung rationale); TPU steps are long enough for a short loop
+    iters = 30 if on_tpu else 100
+
+    p, s = params, opt_state
+    for _ in range(3):  # warmup/compile; both loops run the identical program
+        p, s, loss = step(p, s, batch)
+    float(loss)
+
+    def _median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    def timed_loop(traced: bool):
+        if traced:
+            out = tempfile.mkdtemp(prefix="bench_trace_")
+            init_ndtimers(rank=0, handlers=[LocalRawHandler(os.path.join(out, "spans.jsonl"))])
+        p, s = params, opt_state
+        ts = [time.perf_counter()]
+        for i in range(iters):
+            p, s, loss = step(p, s, batch)
+            float(loss)
+            # cadenced drain (the step counter advances via the train
+            # step's own auto_inc_step — a manual next_iteration here
+            # would double-count)
+            if traced and (i + 1) % 50 == 0:
+                flush()
+            ts.append(time.perf_counter())
+        if traced:
+            flush()
+        return _median([b - a for a, b in zip(ts, ts[1:])])
+
+    bare = timed_loop(traced=False)
+    traced = timed_loop(traced=True)
+    overhead = traced - bare
+    print(json.dumps({
+        "metric": "trace_overhead_ms_per_step",
+        "value": round(overhead * 1e3, 4),
+        "unit": "ms",
+        "overhead_frac": round(overhead / bare, 4) if bare > 0 else None,
+        "step_ms_bare": round(bare * 1e3, 3),
+        "step_ms_traced": round(traced * 1e3, 3),
+        "target_frac": 0.01,
+        "cost_model": _cost_model_line(),
     }))
 
 
@@ -810,6 +922,8 @@ def _dispatch():
         bench_longctx()
     elif which == "memtrack":
         bench_memtrack()
+    elif which == "trace":
+        bench_trace()
     elif which == "resilience":
         bench_resilience()
     elif which == "watchdog":

@@ -12,6 +12,8 @@ echo "=== elastic world-size smoke (2->1 and 1->2 resume, bit-identical)"
 python scripts/elastic_smoke.py || failed=1
 echo "=== quantized grad-collective smoke (int8 bytes ratio, emulator bit-for-bit, e2e loss)"
 python scripts/quantcomm_smoke.py || failed=1
+echo "=== trace + calibration smoke (merged perfetto trace, measured planner costs)"
+python scripts/trace_smoke.py || failed=1
 for f in tests/test_*.py; do
   echo "=== $f"
   python -m pytest "$f" -q || failed=1

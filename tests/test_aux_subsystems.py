@@ -315,8 +315,9 @@ def test_ndtimeline(tmp_path):
     assert len(spans) == 2 and spans[1].step == 1
     chrome.write()
     data = json.loads(open(trace_path).read())
-    assert len(data["traceEvents"]) == 2
-    assert data["traceEvents"][0]["name"] == "forward-compute"
+    xs = [e for e in data["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 2
+    assert xs[0]["name"] == "forward-compute"
     assert os.path.getsize(str(tmp_path / "raw.jsonl")) > 0
 
 

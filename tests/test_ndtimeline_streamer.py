@@ -58,7 +58,10 @@ def test_collector_feeds_chrome_trace(tmp_path):
         assert _wait_until(lambda: streamer.received >= 1)
         path = chrome.write()
         events = json.load(open(path))["traceEvents"]
-        assert events and events[0]["pid"] == 3 and events[0]["name"] == "step"
+        xs = [e for e in events if e["ph"] == "X"]
+        assert xs and xs[0]["pid"] == 3 and xs[0]["name"] == "step"
+        # perfetto metadata names the rank's process lane
+        assert any(e["ph"] == "M" and e["name"] == "process_name" for e in events)
     finally:
         streamer.stop()
 

@@ -248,17 +248,19 @@ def test_chrome_trace_handler_emits_loadable_trace(tmp_path):
     )
     out = h.write()
     doc = json.load(open(out))  # loadable JSON
-    events = doc["traceEvents"]
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
     assert len(events) == 3
     for ev in events:
-        assert ev["ph"] == "X"  # complete events, the chrome://tracing core
         for key in ("name", "ts", "dur", "pid", "tid", "args"):
             assert key in ev
         assert ev["ts"] >= 0 and ev["dur"] > 0
-    # spans recorded in order emit monotonically non-decreasing timestamps
+    # duration events are written sorted by timestamp
     ts = [ev["ts"] for ev in events]
     assert ts == sorted(ts)
     assert {ev["pid"] for ev in events} == {0, 1}  # rank -> pid lanes
+    # perfetto metadata: every pid lane is named
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {e["pid"] for e in meta if e["name"] == "process_name"} == {0, 1}
 
 
 # ------------------------------------------------- ndtimeline satellites

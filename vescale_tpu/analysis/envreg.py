@@ -283,6 +283,12 @@ register("VESCALE_ELASTIC_LOADER", "bool", False,
 register("VESCALE_ELASTIC_RESTORE", "bool", True,
          "Allow restoring a checkpoint written by a different mesh/world size (reshard-on-load, VSC130); `0` refuses cross-world restores with a VSC132 finding.")
 
+# --- trace timeline / cost calibration -------------------------------
+register("VESCALE_COST_CALIBRATION", "str", None,
+         "Path to a measured collective-cost table (collective_calibration.json): planner/scheduler/cost functions answer from interpolated measured wall-times, falling back to the analytic model with a one-time warning per missing bucket; unset (or an empty/stale table) keeps the analytic bandwidth-factor model bit-identically (docs/observability.md).")
+register("VESCALE_CLOCK_SYNC_ROUNDS", "int", 8,
+         "Rounds of allgather wall-clock exchange used by telemetry.trace.estimate_clock_offsets to estimate per-rank clock offsets (more rounds tighten the residual).")
+
 # --- bench harness ---------------------------------------------------
 register("VESCALE_BENCH", "str", None,
          "Which bench rung to run (e.g. `serve`, `redistribute`, `memtrack`, `watchdog`); unset = default MFU line.")

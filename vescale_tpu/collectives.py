@@ -73,6 +73,7 @@ __all__ = [
     "q_psum_scatter",
     "q_all_to_all",
     "allgather_cost",
+    "analytic_cost_us",
     "allreduce_cost",
     "reduce_scatter_cost",
     "all_to_all_cost",
@@ -515,6 +516,13 @@ def reduce_scatter_q(tensor, mesh: DeviceMesh, reduce_op: str = "sum",
 # Bandwidth-factor model mirroring _collective_utils.py:406-475: cost in
 # microseconds for `bytes_gb` gigabytes over a mesh dim of size n.  The
 # factors are tuned for TPU ICI (~100 GB/s per link v5p) instead of NCCL.
+#
+# Calibrated mode (telemetry/calibrate.py): when VESCALE_COST_CALIBRATION
+# arms a measured table, each cost function answers from the table's
+# (op, mesh-dim size, byte bucket) wall-times — interpolated between
+# buckets — and falls back to the analytic formula below (with a one-time
+# warning per missing op/axis pair) otherwise.  Without a table, or with an
+# EMPTY one, the numbers are bit-identical to the analytic model.
 _ICI_GBPS = 100.0
 _LAUNCH_US = 1.0  # per-op overhead (vs reference's kernel-launch constant)
 
@@ -525,20 +533,39 @@ def _ring_cost(bytes_gb: float, n: int, steps_factor: float) -> float:
     return _LAUNCH_US + (bytes_gb * steps_factor * (n - 1) / n) / _ICI_GBPS * 1e6
 
 
+def _measured_us(op: str, num_devices: int, bytes_gb: float):
+    from .telemetry import calibrate as _cal
+
+    return _cal.collective_cost_us(op, num_devices, bytes_gb * 1e9)
+
+
+def analytic_cost_us(op: str, bytes_gb: float, num_devices: int) -> float:
+    """The pure bandwidth-factor cost (never consults the calibration
+    table) — the planner's in-calibrated-mode fallback for ops whose
+    bucket is missing, so one Dijkstra never mixes denominations."""
+    factors = {"all_gather": 1.0, "reduce_scatter": 1.0, "all_to_all": 1.0,
+               "all_reduce": 2.0, "ppermute": 1.0}
+    return _ring_cost(bytes_gb, num_devices, factors[op])
+
+
 def allgather_cost(bytes_gb: float, num_devices: int) -> float:
-    return _ring_cost(bytes_gb, num_devices, 1.0)
+    us = _measured_us("all_gather", num_devices, bytes_gb)
+    return us if us is not None else _ring_cost(bytes_gb, num_devices, 1.0)
 
 
 def reduce_scatter_cost(bytes_gb: float, num_devices: int) -> float:
-    return _ring_cost(bytes_gb, num_devices, 1.0)
+    us = _measured_us("reduce_scatter", num_devices, bytes_gb)
+    return us if us is not None else _ring_cost(bytes_gb, num_devices, 1.0)
 
 
 def allreduce_cost(bytes_gb: float, num_devices: int) -> float:
-    return _ring_cost(bytes_gb, num_devices, 2.0)
+    us = _measured_us("all_reduce", num_devices, bytes_gb)
+    return us if us is not None else _ring_cost(bytes_gb, num_devices, 2.0)
 
 
 def all_to_all_cost(bytes_gb: float, num_devices: int) -> float:
-    return _ring_cost(bytes_gb, num_devices, 1.0)
+    us = _measured_us("all_to_all", num_devices, bytes_gb)
+    return us if us is not None else _ring_cost(bytes_gb, num_devices, 1.0)
 
 
 def redistribute_cost(src_spec, dst_spec) -> float:

@@ -99,10 +99,16 @@ class NDTimerManager:
     def tail(self, n: int = 200) -> List[Span]:
         """Last ``n`` buffered spans WITHOUT draining them — the flight
         recorder's peek (an OOM dump must not steal spans from the flush a
-        surviving handler still expects)."""
+        surviving handler still expects).  O(n), not O(ring): the per-step
+        span summary (telemetry.record_step) peeks every step and must not
+        copy a 100k-deep ring to read its newest few hundred entries."""
+        import itertools
+
         with self._lock:
-            spans = list(self._spans)
-        return spans[-n:]
+            if n >= len(self._spans):
+                return list(self._spans)
+            newest_first = list(itertools.islice(reversed(self._spans), n))
+        return newest_first[::-1]
 
     # ----------------------------------------------------------- flush
     def flush(self, step_range=None) -> List[Span]:

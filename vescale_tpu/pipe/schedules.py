@@ -510,22 +510,61 @@ def zero_bubble_cost_schedule(
     return [list(stage) for stage in cached]  # callers may mutate their copy
 
 
-def estimate_stage_costs(pipe_module, params_per_group, x_example, comm: float = 0.0) -> StageCosts:
+def estimate_stage_costs(
+    pipe_module, params_per_group, x_example, comm: Optional[float] = 0.0
+) -> StageCosts:
     """Per-stage costs from the graph FLOP model — the profiling role of the
     reference's CostGraph (zero_bubble_v.py:198): trace each group's forward
     (``jax.make_jaxpr`` on avals, no execution), total its FLOPs, and assume
     the standard 1:1:1 F:Bd:W ratio.  ``x_example`` is the stage-0 input
     (array or ShapeDtypeStruct); activations chain through ``eval_shape``.
-    Requires one group per stage (V=1, the cost-schedule's domain)."""
+    Requires one group per stage (V=1, the cost-schedule's domain).
+
+    ``comm=None`` asks for MEASURED units: with a calibration table armed
+    (``VESCALE_COST_CALIBRATION``, telemetry/calibrate.py) carrying a
+    ``matmul_gflops`` throughput sample, stage FLOPs convert to measured
+    microseconds and ``comm`` becomes the table's p2p (ppermute) wall time
+    for the boundary activation's byte size — so ``simulate_schedule``
+    ranks candidate schedules by wall-clock, not abstract FLOPs.  Without a
+    (usable) table, ``comm=None`` degrades to the legacy ``comm=0.0``
+    FLOP-denominated behavior, bit-identically."""
     import jax
+    import numpy as np
 
     from .graph_split import jaxpr_flops
 
     weights, x = [], x_example
+    act_bytes = None
     for g in range(pipe_module.num_groups):
         fwd = pipe_module.group_forward(g)
         weights.append(jaxpr_flops(jax.make_jaxpr(fwd)(params_per_group[g], x)))
         x = jax.eval_shape(fwd, params_per_group[g], x)
+        if g == 0:  # the stage-boundary activation every p2p hop moves
+            act_bytes = sum(
+                int(np.prod(l.shape)) * l.dtype.itemsize
+                for l in jax.tree_util.tree_leaves(x)
+            )
+    if comm is None:
+        from ..telemetry import calibrate as _cal
+
+        # table_for, not active_table: the platform staleness check must
+        # gate the matmul_gflops conversion too — a gloo-CPU throughput
+        # sample silently inflating TPU stage costs would skew the
+        # compute:comm ratio simulate_schedule ranks by
+        table = _cal.table_for(None)
+        gflops = (table.meta.get("matmul_gflops") if table is not None else None)
+        if table is not None and gflops:
+            us_per_flop = 1.0 / (float(gflops) * 1e3)  # GFLOP/s -> us/FLOP
+            n = max(2, pipe_module.num_groups)
+            comm_us = _cal.table_cost_us(table, "ppermute", n, act_bytes or 0)
+            if comm_us is None:
+                from .. import collectives as C
+
+                comm_us = C.analytic_cost_us("ppermute", (act_bytes or 0) / 1e9, n)
+            return StageCosts.from_weights(
+                [w * us_per_flop for w in weights], comm=comm_us
+            )
+        comm = 0.0  # no usable table: legacy FLOP units
     return StageCosts.from_weights(weights, comm=comm)
 
 

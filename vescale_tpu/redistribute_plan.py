@@ -126,6 +126,88 @@ _QWEIGHTS = {
 }
 _QUANT_COMPUTE_WEIGHT = 1.0  # cost units per tensor byte quantized/dequantized
 
+# ---------------------------------------------------------- calibrated mode
+# With a measured collective-cost table armed (VESCALE_COST_CALIBRATION,
+# telemetry/calibrate.py) the WHOLE search re-denominates from bytes x
+# weight into measured microseconds: every wire op prices at the table's
+# interpolated wall time for its (op, mesh-dim size, byte) point, ops with
+# no measured bucket fall back to the ANALYTIC microsecond model
+# (collectives.analytic_cost_us — same unit, so one Dijkstra never compares
+# bytes against us), and the flat hop-latency term becomes the measured
+# launch overhead.  Without a table — or with an empty or stale one — every
+# branch below takes the legacy path and costs are bit-identical to the
+# byte-weight model.  _CAL_OP maps an edge's wire kind to the measured op
+# vocabulary + a conservatism factor (reshard/device_put let the
+# runtime/GSPMD pick the pattern, so they price at 2x the measured
+# all-to-all, mirroring their 2.0 byte weight); the quantized tags map to
+# the wire PATTERN they execute (module comment above _QWEIGHTS).
+_CAL_OP = {
+    "all_to_all": ("all_to_all", 1.0),
+    "collective_permute": ("all_to_all", 1.0),
+    "reduce_scatter": ("reduce_scatter", 1.0),
+    "all_gather": ("all_gather", 1.0),
+    "all_reduce": ("all_reduce", 1.0),
+    "reshard": ("all_to_all", 2.0),
+    "device_put": ("all_to_all", 2.0),
+    "all_reduce:int8": ("all_gather", 1.0),
+    "all_gather:int8": ("all_gather", 1.0),
+    "reduce_scatter:int8": ("all_to_all", 1.0),
+    "all_to_all:int8": ("all_to_all", 1.0),
+}
+
+
+def _cal_table(mesh):
+    """The armed, non-empty, mesh-matching calibration table or None
+    (stale tables warn once inside table_for and resolve to None)."""
+    from .telemetry import calibrate as _cal
+
+    return _cal.table_for(mesh)
+
+
+def _cal_key():
+    """Calibration signature for the plan caches: the armed non-empty
+    table's digest, else None.  Arming, swapping or clearing the table
+    must re-search, not re-serve plans priced under another cost model."""
+    from .telemetry import calibrate as _cal
+
+    return _cal.active_digest()
+
+
+def _cal_wire_us(table, kind: str, nbytes: float, n: int) -> float:
+    """Calibrated-mode price of one wire op against the ALREADY-RESOLVED
+    table (no per-op env/mtime re-resolution on the Dijkstra hot path):
+    measured (interpolated) wall microseconds, analytic microseconds when
+    the bucket is missing.  ``nbytes`` is the per-rank OPERAND payload —
+    the unit the sweep keys buckets by."""
+    from . import collectives as C
+    from .telemetry import calibrate as _cal
+
+    op, scale = _CAL_OP[kind]
+    us = _cal.table_cost_us(table, op, n, nbytes)
+    if us is None:
+        us = C.analytic_cost_us(op, float(nbytes) / 1e9, n)
+    return us * scale
+
+
+def _hop_lat(table) -> float:
+    if table is None:
+        return _HOP_LATENCY
+    from .telemetry import calibrate as _cal
+
+    return _cal.hop_latency_us()
+
+
+def _edge_fanin(src: DArraySpec, dst: DArraySpec) -> int:
+    """Fan-in for edges whose per-dim wire ops aren't enumerated (ragged /
+    interleaved / reshard): the largest mesh dim the transition actually
+    changes, else the largest mesh dim."""
+    ns = [
+        src.mesh.shape[i]
+        for i, (s, d) in enumerate(zip(src.placements, dst.placements))
+        if s != d
+    ]
+    return max(ns) if ns else max(src.mesh.shape)
+
 
 def _mem_factor() -> float:
     return envreg.get_float("VESCALE_REDISTRIBUTE_MEM_FACTOR")
@@ -248,26 +330,33 @@ def _dense_edge(src: DArraySpec, dst: DArraySpec, build: bool) -> Optional[PlanH
     colls: Dict[str, int] = {}
     bytes_m = 0
     cost = 0.0
+    table = _cal_table(src.mesh)
     sb, db = src.per_shard_bytes(), dst.per_shard_bytes()
     for op in ops:
         kind, i = op[0], op[1]
         n = src.mesh.shape[i]
         f = (n - 1) / max(1, n)
+        # b: ring-scaled wire-byte estimate (legacy cost + telemetry);
+        # payload: the PER-RANK operand bytes the op moves — the
+        # calibration table is keyed by the sweep's per-rank input size
+        # (a gather's contribution is the SOURCE shard, not the gathered
+        # output), so the measured lookup and its analytic-us fallback
+        # must see that payload or reduce/gather ops get double-scaled
         if kind == "reduce":
-            b, c = 2 * f * max(sb, db), "all_reduce"
+            b, c, payload = 2 * f * max(sb, db), "all_reduce", max(sb, db)
         elif kind == "reduce_scatter":
-            b, c = f * sb, "reduce_scatter"
+            b, c, payload = f * sb, "reduce_scatter", sb
         elif kind == "gather":
-            b, c = f * db, "all_gather"
+            b, c, payload = f * db, "all_gather", sb
         elif kind == "move":
-            b, c = f * max(sb, db), "all_to_all"
+            b, c, payload = f * max(sb, db), "all_to_all", max(sb, db)
         else:  # slice / seed: local index math, no wire traffic
             continue
         colls[c] = colls.get(c, 0) + 1
         bytes_m += int(b)
-        cost += _WEIGHTS[c] * b
+        cost += _WEIGHTS[c] * b if table is None else _cal_wire_us(table, c, payload, n)
     fn = transition_fn(src, dst) if build else None
-    return PlanHop("dense", src, dst, fn, colls, bytes_m, cost + _HOP_LATENCY)
+    return PlanHop("dense", src, dst, fn, colls, bytes_m, cost + _hop_lat(table))
 
 
 def _ragged_edge(src: DArraySpec, dst: DArraySpec, build: bool) -> Optional[PlanHop]:
@@ -280,13 +369,23 @@ def _ragged_edge(src: DArraySpec, dst: DArraySpec, build: bool) -> Optional[Plan
         return None
     sb, db = src.per_shard_bytes(), dst.per_shard_bytes()
     if src.has_ragged() and dst.is_replicated():
-        colls, b, w = {"all_gather": 1}, db, _WEIGHTS["all_gather"]
+        colls, b, kind = {"all_gather": 1}, db, "all_gather"
     elif src.is_replicated() and dst.has_ragged():
-        colls, b, w = {}, 0, 0.0  # slice-v: local, no comm
+        colls, b, kind = {}, 0, None  # slice-v: local, no comm
     else:  # all-to-all-v as ppermute rounds
-        colls, b, w = {"collective_permute": 1}, max(sb, db), _WEIGHTS["all_to_all"]
+        colls, b, kind = {"collective_permute": 1}, max(sb, db), "collective_permute"
+    table = _cal_table(src.mesh)
+    if kind is None:
+        wire = 0.0
+    elif table is None:
+        wire = _WEIGHTS["all_to_all" if kind == "collective_permute" else kind] * b
+    else:
+        # measured lookup at the per-rank contribution (the gather-v's
+        # operand is the SOURCE ragged shard, not the gathered output)
+        payload = sb if kind == "all_gather" else b
+        wire = _cal_wire_us(table, kind, payload, _edge_fanin(src, dst))
     return PlanHop(
-        "ragged", src, dst, fn if build else None, colls, int(b), w * b + _HOP_LATENCY
+        "ragged", src, dst, fn if build else None, colls, int(b), wire + _hop_lat(table)
     )
 
 
@@ -299,6 +398,12 @@ def _interleaved_edge(src: DArraySpec, dst: DArraySpec, build: bool) -> Optional
     if fn is None:
         return None
     b = max(src.per_shard_bytes(), dst.per_shard_bytes())
+    table = _cal_table(src.mesh)
+    wire = (
+        _WEIGHTS["all_to_all"] * b
+        if table is None
+        else _cal_wire_us(table, "collective_permute", b, _edge_fanin(src, dst))
+    )
     return PlanHop(
         "interleaved",
         src,
@@ -306,7 +411,7 @@ def _interleaved_edge(src: DArraySpec, dst: DArraySpec, build: bool) -> Optional
         fn if build else None,
         {"collective_permute": 1},
         int(b),
-        _WEIGHTS["all_to_all"] * b + _HOP_LATENCY,
+        wire + _hop_lat(table),
     )
 
 
@@ -326,8 +431,14 @@ def _reshard_edge(src: DArraySpec, dst: DArraySpec) -> Optional[PlanHop]:
         ):
             return None
     b = max(src.per_shard_bytes(), dst.per_shard_bytes())
+    table = _cal_table(src.mesh)
+    wire = (
+        _WEIGHTS["reshard"] * b
+        if table is None
+        else _cal_wire_us(table, "reshard", b, _edge_fanin(src, dst))
+    )
     return PlanHop(
-        "reshard", src, dst, None, {"reshard": 1}, int(b), _WEIGHTS["reshard"] * b + _HOP_LATENCY
+        "reshard", src, dst, None, {"reshard": 1}, int(b), wire + _hop_lat(table)
     )
 
 
@@ -349,9 +460,23 @@ def _quant_edge(src: DArraySpec, dst: DArraySpec, build: bool) -> Optional[PlanH
     if info is None:
         return None
     _ops, colls, q_bytes, raw_bytes, compute_bytes, wire_detail = info
-    cost = _QUANT_COMPUTE_WEIGHT * compute_bytes
-    for tag, q_op_bytes in wire_detail:  # each op's OWN bytes at its weight
-        cost += _QWEIGHTS[tag] * q_op_bytes
+    table = _cal_table(src.mesh)
+    if table is None:
+        cost = _QUANT_COMPUTE_WEIGHT * compute_bytes
+        for tag, q_op_bytes, _n, _p in wire_detail:  # each op's OWN bytes at its weight
+            cost += _QWEIGHTS[tag] * q_op_bytes
+    else:
+        # measured mode: the PACKED PAYLOAD priced at the wire pattern's
+        # measured wall time (per op, at its own fan-in — the table is
+        # keyed by operand payload, not ring-scaled wire bytes), and
+        # quantize/dequantize compute at the calibrated elementwise rate —
+        # same us denomination the competing dense edge uses, so the
+        # competition stays fair
+        from .telemetry import calibrate as _cal
+
+        cost = _cal.compute_cost_us(compute_bytes)
+        for tag, _q, n, payload in wire_detail:
+            cost += _cal_wire_us(table, tag, payload, n)
     fn = None
     if build:
         base = quant_transition_fn(src, dst, block, rounding)
@@ -366,7 +491,7 @@ def _quant_edge(src: DArraySpec, dst: DArraySpec, build: bool) -> Optional[PlanH
         else:
             fn = base
     return PlanHop(
-        "quant", src, dst, fn, colls, int(q_bytes), cost + _HOP_LATENCY, int(raw_bytes)
+        "quant", src, dst, fn, colls, int(q_bytes), cost + _hop_lat(table), int(raw_bytes)
     )
 
 
@@ -527,6 +652,15 @@ def _plan_cross_mesh(
                 "VSC124", f"cross-mesh: source-side strip failed — {reason}"
             )
         hops.extend(sub)
+    # calibrated pricing of the bridge follows the DESTINATION mesh's table
+    # (each same-mesh sub-search already prices under its own mesh's table)
+    table = _cal_table(dmid.mesh)
+    db = dmid.per_shard_bytes()
+    bridge_cost = (
+        _WEIGHTS["device_put"] * db
+        if table is None
+        else _cal_wire_us(table, "device_put", db, max(dmid.mesh.shape))
+    )
     hops.append(
         PlanHop(
             "device_put",
@@ -534,8 +668,8 @@ def _plan_cross_mesh(
             dmid,
             None,
             {"device_put": 1},
-            int(dmid.per_shard_bytes()),
-            _WEIGHTS["device_put"] * dmid.per_shard_bytes() + _HOP_LATENCY,
+            int(db),
+            bridge_cost + _hop_lat(table),
         )
     )
     if dmid != dst:
@@ -622,7 +756,7 @@ def plan_redistribute(src: DArraySpec, dst: DArraySpec) -> Optional[Redistribute
     # the knobs are part of the key: raising VESCALE_REDISTRIBUTE_MEM_FACTOR
     # after a budget decline (as the fallback warning instructs) must
     # re-search, not re-serve the cached decline — same for the quant gate
-    key = (src, dst, _mem_factor(), _max_hops(), _quant_sig())
+    key = (src, dst, _mem_factor(), _max_hops(), _quant_sig(), _cal_key())
     plan = _PLANS.get(key)
     if plan is not None:
         _tel.count("redistribute.plan_hits")
@@ -651,7 +785,7 @@ _NOT_CONSULTED = Decline("VSC126", "planner was not consulted for this pair")
 def decline_finding(src: DArraySpec, dst: DArraySpec) -> Decline:
     """The structured decline for (src, dst): a ``VSC12x``-coded
     :class:`Decline` (VSC126 when the planner never saw the pair)."""
-    d = _DECLINES.get((src, dst, _mem_factor(), _max_hops(), _quant_sig()))
+    d = _DECLINES.get((src, dst, _mem_factor(), _max_hops(), _quant_sig(), _cal_key()))
     return d if d is not None else _NOT_CONSULTED
 
 
@@ -667,7 +801,7 @@ def quant_single_hop_plan(src: DArraySpec, dst: DArraySpec) -> Optional[Redistri
     sig = _quant_sig()
     if sig is None or src.mesh != dst.mesh or src == dst:
         return None
-    key = (src, dst, _mem_factor(), _max_hops(), sig)
+    key = (src, dst, _mem_factor(), _max_hops(), sig, _cal_key())
     plan = _PLANS.get(key)
     if plan is not None:
         from . import telemetry as _tel
@@ -699,7 +833,7 @@ def quant_outcome(src: DArraySpec, dst: DArraySpec):
     d = _dense_edge(src, dst, build=False)
     if q is not None and (d is None or q.cost < d.cost):
         return ("taken", q)
-    key = (src, dst, _mem_factor(), _max_hops(), sig)
+    key = (src, dst, _mem_factor(), _max_hops(), sig, _cal_key())
     _record_quant_outcome(key, src, dst, None)
     return ("declined", _QUANT_DECLINES.get(key))
 
@@ -713,7 +847,7 @@ def quant_decline_finding(src: DArraySpec, dst: DArraySpec) -> Optional[Decline]
     sig = _quant_sig()
     if sig is None:
         return None
-    return _QUANT_DECLINES.get((src, dst, _mem_factor(), _max_hops(), sig))
+    return _QUANT_DECLINES.get((src, dst, _mem_factor(), _max_hops(), sig, _cal_key()))
 
 
 def decline_reason(src: DArraySpec, dst: DArraySpec) -> str:

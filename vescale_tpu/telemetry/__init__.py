@@ -15,13 +15,20 @@ Three observability signals, one pipeline (docs/observability.md):
      (host-RSS fallback), owner-tagged live-array census, leak detection,
      AOT-budget drift, and the OOM **flight recorder** (forensic JSON dump
      on RESOURCE_EXHAUSTED or via ``dump_now()``).
+  5. **Distributed trace timeline + cost calibration** (trace.py +
+     calibrate.py): cross-rank clock-offset estimation, merged Perfetto
+     traces with per-step critical paths and pipeline bubble fraction, and
+     the measured collective-cost table (``collective_calibration.json``)
+     that re-prices the redistribution planner, the quant-edge competition
+     and ``simulate_schedule`` from wall-clock data
+     (``VESCALE_COST_CALIBRATION``).
 
 Gating contract (same as ndtimeline): a run that never calls
 ``telemetry.init()`` pays zero overhead — no registry, no locks, no files,
 no tag registry (the memtrack hooks are no-op function references).
 """
 
-from . import memtrack
+from . import calibrate, memtrack, trace
 from .api import (
     count,
     dashboard,
@@ -69,6 +76,8 @@ __all__ = [
     "read_step_report",
     "StragglerDetector",
     "memtrack",
+    "trace",
+    "calibrate",
     "flight_recorder",
     "dump_now",
     "tagged",

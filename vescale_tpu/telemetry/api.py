@@ -169,7 +169,25 @@ def record_step(metrics: Dict[str, Any]) -> None:
         rec = {"step": st.step, "rank": st.rank, "ts": time.time(), **metrics}
         if mem is not None:
             rec["memory"] = mem
+        spans = _step_spans()
+        if spans is not None:
+            rec["spans"] = spans
         st.jsonl.emit(rec)
+
+
+def _step_spans():
+    """Per-metric span rollup of the step being recorded, when the
+    ndtimeline profiler is live — the ``spans`` object of a steps.jsonl
+    line (``{metric: {count, total_ms}}``).  None (and zero cost) when the
+    profiler is dormant; the manager's ring is PEEKED, never drained, so
+    the flush a handler expects still sees every span."""
+    from ..ndtimeline.api import is_active as _nd_active
+
+    if not _nd_active():
+        return None
+    from .trace import step_span_summary
+
+    return step_span_summary()
 
 
 def record_event(kind: str, **fields) -> None:

@@ -152,17 +152,28 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     # quantized gradient collectives + planner quant hops: one
     # grad-compression story (collectives._compress_telemetry feed)
     _qc = ("grad_compress_", "redistribute.quant")
+    # cross-rank trace timeline + per-step critical path (trace.py
+    # record_trace_metrics feed): merge counts, clock residual, bubble
+    # fraction in `trace:`; the extracted chain's numbers in `critical-path:`
+    _tr = ("trace_",)
+    _cp = ("critical_path_",)
     res_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_res)}
     qc_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_qc)}
+    tr_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_tr)}
+    cp_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_cp)}
     other_gauges = {
         n: v
         for n, v in snap["gauges"].items()
-        if not n.startswith(("mem_",) + _res + _qc)
+        if not n.startswith(("mem_",) + _res + _qc + _tr + _cp)
     }
     res_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_res)}
     qc_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_qc)}
+    tr_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_tr)}
+    cp_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_cp)}
     other_counters = {
-        n: v for n, v in snap["counters"].items() if not n.startswith(_res + _qc)
+        n: v
+        for n, v in snap["counters"].items()
+        if not n.startswith(_res + _qc + _tr + _cp)
     }
     if other_counters:
         lines.append("counters:")
@@ -182,6 +193,20 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
             lines.append(f"  {name:<48} {shown:>16}")
         for name in sorted(qc_gauges):
             lines.append(f"  {name:<48} {qc_gauges[name]:>12.6g}")
+    if tr_counters or tr_gauges:
+        # cross-rank trace block: merge totals + clock residual + bubble
+        lines.append("trace:")
+        for name in sorted(tr_counters):
+            lines.append(f"  {name:<48} {_fmt(tr_counters[name]):>12}")
+        for name in sorted(tr_gauges):
+            lines.append(f"  {name:<48} {tr_gauges[name]:>12.6g}")
+    if cp_counters or cp_gauges:
+        # per-step critical path: chain length/coverage of the merged trace
+        lines.append("critical-path:")
+        for name in sorted(cp_counters):
+            lines.append(f"  {name:<48} {_fmt(cp_counters[name]):>12}")
+        for name in sorted(cp_gauges):
+            lines.append(f"  {name:<48} {cp_gauges[name]:>12.6g}")
     if res_counters or res_gauges:
         # recovery-event block (resilience/loop.py feed, mirrors memory:):
         # a zero-fault run shows armed-but-quiet counters at 0

@@ -10,6 +10,17 @@ slow rank must not drag the baseline toward itself — on an 8-rank job a
 It also consumes the offline shape: ``update_from_merged`` takes the
 ``parser_handler.merge_ranks`` rollup, so post-hoc analysis of raw span
 dumps uses the same thresholds as the live collector path.
+
+Clock skew: duration-based flagging is skew-immune (a duration is one
+host's clock differenced against itself), but START-time comparisons —
+"rank 3 enters every step 8 ms after everyone else", the upstream-cause
+view of a straggler — are only meaningful after skew correction.  Feed the
+detector the offsets from ``telemetry.trace.estimate_clock_offsets`` via
+:meth:`set_clock_offsets`; :meth:`lag_report` then compares SKEW-CORRECTED
+per-(metric, step) start times across ranks instead of assuming
+synchronized host clocks, and refuses to flag lags smaller than the
+estimate's own residual (a lag claim below the measurement noise floor is
+not a signal).
 """
 
 from __future__ import annotations
@@ -37,6 +48,9 @@ class StragglerDetector:
     ``window``: per-(metric, rank) rolling sample count.
     ``min_ranks``: below this many reporting ranks there is no population to
     compare against; nothing is flagged.
+    ``lag_threshold_ms``: minimum mean start-time lag behind the cross-rank
+    median for :meth:`lag_report` to flag a rank (raised to the clock-sync
+    residual when that is larger).
     """
 
     def __init__(
@@ -45,6 +59,7 @@ class StragglerDetector:
         window: int = 256,
         min_ranks: int = 2,
         min_excess_ms: float = 0.0,
+        lag_threshold_ms: float = 1.0,
     ):
         if threshold <= 1.0:
             raise ValueError(f"threshold must be > 1.0, got {threshold}")
@@ -52,10 +67,36 @@ class StragglerDetector:
         self.window = int(window)
         self.min_ranks = int(min_ranks)
         self.min_excess_ms = float(min_excess_ms)
+        self.lag_threshold_ms = float(lag_threshold_ms)
         # metric -> rank -> rolling durations (ms)
         self._samples: Dict[str, Dict[int, collections.deque]] = {}
+        # metric -> step -> rank -> earliest skew-corrected start (s);
+        # bounded to the last `window` steps per metric
+        self._starts: Dict[str, "collections.OrderedDict" ] = {}
+        self._offsets_s: Dict[int, float] = {}  # rank -> clock offset vs rank 0
+        self._residual_ms = 0.0
         self._lock = threading.Lock()
         self.spans_seen = 0
+
+    # ---------------------------------------------------------- clock sync
+    def set_clock_offsets(self, clock) -> None:
+        """Arm skew correction with cross-rank clock offsets: a
+        ``telemetry.trace.ClockSync`` or a ``{rank: offset_seconds}``
+        mapping (offsets relative to rank 0, as ``estimate_clock_offsets``
+        reports them).  Spans already ingested are NOT re-aligned — arm the
+        offsets before attaching the detector to a streamer."""
+        from .trace import ClockSync
+
+        with self._lock:
+            if isinstance(clock, ClockSync):
+                self._offsets_s = {r: clock.offset_s(r) for r in range(len(clock.offsets_us))}
+                self._residual_ms = clock.residual_us / 1e3
+            else:
+                self._offsets_s = {int(r): float(o) for r, o in dict(clock).items()}
+                self._residual_ms = 0.0
+
+    def _aligned_start(self, span) -> float:
+        return span.start - self._offsets_s.get(span.rank, 0.0)
 
     # -------------------------------------------------------------- feeds
     def __call__(self, spans) -> None:
@@ -67,6 +108,12 @@ class StragglerDetector:
                 )
                 dq.append(s.duration * 1e3)
                 self.spans_seen += 1
+                steps = self._starts.setdefault(s.metric, collections.OrderedDict())
+                cell = steps.setdefault(int(s.step), {})
+                t = self._aligned_start(s)
+                cell[s.rank] = min(cell.get(s.rank, t), t)
+                while len(steps) > self.window:
+                    steps.popitem(last=False)
 
     def update_from_merged(self, merged: Dict[tuple, Dict]) -> None:
         """Ingest a ``parser_handler.merge_ranks`` rollup: ``{(step, metric):
@@ -114,12 +161,51 @@ class StragglerDetector:
         out.sort(key=lambda e: e["ratio"], reverse=True)
         return out
 
+    def lag_report(self, metric: Optional[str] = None) -> List[Dict]:
+        """Start-time stragglers: ranks that ENTER a metric's region late
+        relative to the cross-rank median of SKEW-CORRECTED start times,
+        averaged over the retained steps.  A rank busy exactly as long as
+        its peers but consistently starting late points at an upstream
+        cause (slow input pipeline, late collective exit) that duration
+        ratios cannot see.  Flags mean lags above ``lag_threshold_ms`` OR
+        the clock-sync residual, whichever is larger — below the residual
+        the 'lag' is indistinguishable from clock noise.  Entries:
+        ``{metric, rank, mean_lag_ms, steps}``, worst first."""
+        floor = max(self.lag_threshold_ms, self._residual_ms)
+        with self._lock:
+            metrics = [metric] if metric is not None else list(self._starts)
+            snap = {
+                m: {step: dict(cell) for step, cell in self._starts.get(m, {}).items()}
+                for m in metrics
+            }
+        out: List[Dict] = []
+        for m, steps in snap.items():
+            lags: Dict[int, List[float]] = {}
+            for cell in steps.values():
+                if len(cell) < self.min_ranks:
+                    continue
+                med = _median(list(cell.values()))
+                for rank, t in cell.items():
+                    lags.setdefault(rank, []).append((t - med) * 1e3)
+            for rank, ls in lags.items():
+                mean_lag = sum(ls) / len(ls)
+                if mean_lag > floor:
+                    out.append(
+                        {"metric": m, "rank": rank, "mean_lag_ms": mean_lag, "steps": len(ls)}
+                    )
+        out.sort(key=lambda e: e["mean_lag_ms"], reverse=True)
+        return out
+
     def healthy(self) -> bool:
-        return not self.report()
+        # both straggler shapes gate health: duration outliers AND
+        # skew-corrected start-time lags (summary() prints both; automation
+        # reacting to healthy() must see what summary() names)
+        return not self.report() and not self.lag_report()
 
     def summary(self) -> str:
         flagged = self.report()
-        if not flagged:
+        lagged = self.lag_report()
+        if not flagged and not lagged:
             return "stragglers: none"
         lines = ["stragglers:"]
         for e in flagged:
@@ -127,5 +213,11 @@ class StragglerDetector:
                 f"  rank {e['rank']:<4} {e['metric']:<28} "
                 f"{e['mean_ms']:.3f} ms vs median {e['median_ms']:.3f} ms "
                 f"({e['ratio']:.2f}x)"
+            )
+        for e in lagged:
+            lines.append(
+                f"  rank {e['rank']:<4} {e['metric']:<28} "
+                f"starts {e['mean_lag_ms']:.3f} ms late (skew-corrected, "
+                f"{e['steps']} steps)"
             )
         return "\n".join(lines)

@@ -672,8 +672,12 @@ def quant_plan_info(src: DArraySpec, dst: DArraySpec, block: int = 64):
     planner's cost model charges on the wire, ``raw_bytes`` the
     unquantized payload the same wire ops would move, ``compute_bytes``
     the tensor bytes the quantize/dequantize elementwise passes touch, and
-    ``wire_detail`` a per-wire-op ``(tag, q_bytes_op)`` list so the cost
-    model can weight each op's OWN bytes (not an average).  Quantized
+    ``wire_detail`` a per-wire-op ``(tag, q_bytes_op, mesh_dim_size,
+    packed_payload)`` list so the cost model can weight each op's OWN bytes
+    (not an average) and, in calibrated mode, look up the measured wall
+    time for the op's actual fan-in at its raw packed PAYLOAD size — the
+    calibration table is keyed by operand payload, not ring-scaled wire
+    bytes (telemetry/calibrate.py).  Quantized
     all-reduce is gather-based (quantize ONCE, no per-hop requantization),
     so both its wire bytes and its dequantize-accumulate compute scale
     with the mesh-dim size — the cost model sees that honestly and
@@ -694,7 +698,7 @@ def quant_plan_info(src: DArraySpec, dst: DArraySpec, block: int = 64):
     q_bytes = 0.0
     raw_bytes = 0.0
     compute_bytes = 0.0
-    wire_detail: List[Tuple[str, int]] = []
+    wire_detail: List[Tuple[str, int, int, int]] = []
     for op in wire:
         kind, i = op[0], op[1]
         n = src.mesh.shape[i]
@@ -706,27 +710,34 @@ def quant_plan_info(src: DArraySpec, dst: DArraySpec, block: int = 64):
             # packed contributions of its full shard and dequantize-adds
             # all n of them
             elems = sb // itemsize
-            q, r, c = f * n * packed_nbytes(int(elems), block), 2 * f * sb, "all_reduce:int8"
+            payload = packed_nbytes(int(elems), block)
+            q, r, c = f * n * payload, 2 * f * sb, "all_reduce:int8"
             comp = (1 + n) * sb
         elif kind == "reduce_scatter":
             if op[2] not in ("sum", "avg"):
                 return None
             elems = sb // itemsize
-            q, r, c = f * packed_nbytes(int(elems), block), f * sb, "reduce_scatter:int8"
+            payload = packed_nbytes(int(elems), block)
+            q, r, c = f * payload, f * sb, "reduce_scatter:int8"
             comp = 2 * sb  # quantize full operand + dequant n chunks of sb/n
         elif kind == "gather":
             elems = db // itemsize
+            # per-rank contribution: each rank quantizes and sends its OWN
+            # chunk (db/n) — the calibrated lookup is keyed by that payload;
+            # the wire estimate q still totals all n chunks' packed bytes
+            payload = packed_nbytes(int(elems) // max(1, n), block)
             q, r, c = f * packed_nbytes(int(elems), block), f * db, "all_gather:int8"
             comp = db // max(1, n) + db  # quantize own chunk, dequant all n
         else:  # move
             elems = max(sb, db) // itemsize
-            q, r, c = f * packed_nbytes(int(elems), block), f * max(sb, db), "all_to_all:int8"
+            payload = packed_nbytes(int(elems), block)
+            q, r, c = f * payload, f * max(sb, db), "all_to_all:int8"
             comp = 2 * max(sb, db)
         colls[c] = colls.get(c, 0) + 1
         q_bytes += q
         raw_bytes += r
         compute_bytes += comp
-        wire_detail.append((c, int(q)))
+        wire_detail.append((c, int(q), int(n), int(payload)))
     return ops, colls, int(q_bytes), int(raw_bytes), int(compute_bytes), wire_detail
 
 
