@@ -39,7 +39,6 @@ tests/test_quantcomm.py.
 import hashlib
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
@@ -261,58 +260,42 @@ def child_e2e() -> None:
 
 
 # ------------------------------------------------------------------ driver
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+_SCRUB = ("VESCALE_GRAD_COMPRESS", "VESCALE_GRAD_COMPRESS_SR",
+          "VESCALE_GRAD_COMPRESS_BLOCK", "VESCALE_GRAD_COMPRESS_SEED",
+          "VESCALE_REDISTRIBUTE_QUANT")
 
 
-def _env(device_count: int, extra=None):
-    env = dict(os.environ)
-    for k in ("VESCALE_COORDINATOR", "VESCALE_NUM_PROCESSES", "VESCALE_PROCESS_ID",
-              "VESCALE_GRAD_COMPRESS", "VESCALE_GRAD_COMPRESS_SR",
-              "VESCALE_GRAD_COMPRESS_BLOCK", "VESCALE_GRAD_COMPRESS_SEED",
-              "VESCALE_REDISTRIBUTE_QUANT"):
-        env.pop(k, None)
-    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=f"{REPO}:{env.get('PYTHONPATH', '')}")
-    flags = [f for f in env.get("XLA_FLAGS", "").split()
-             if "host_platform_device_count" not in f]
-    env["XLA_FLAGS"] = " ".join(
-        flags + [f"--xla_force_host_platform_device_count={device_count}"]
-    )
-    if extra:
-        env.update({k: str(v) for k, v in extra.items()})
-    return env
+def _env(device_count: int, extra=None, port: int = 0, pid: int = 0, world: int = 1):
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from vescale_tpu.testing import make_child_env
+
+    return make_child_env(port, pid, world, device_count=device_count,
+                          scrub=_SCRUB, extra=extra)
 
 
 def run_rig(timeout=240):
     """Spawn the 2-process x 1-device gloo rig; returns (rank0 stats dict,
-    [per-rank digests])."""
-    port = _free_port()
-    procs = []
-    for pid in range(WORLD):
-        env = _env(1, {
-            "VESCALE_COORDINATOR": f"localhost:{port}",
-            "VESCALE_NUM_PROCESSES": WORLD,
-            "VESCALE_PROCESS_ID": pid,
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--child-rig"],
-            env=env, cwd=REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True,
-        ))
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(out)
+    [per-rank digests]).  Ports from the session-unique registry, one
+    bounded transport-setup retry (vescale_tpu.testing)."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from vescale_tpu.testing import run_gloo_world
+
+    def spawn(port):
+        procs = []
+        for pid in range(WORLD):
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--child-rig"],
+                env=_env(1, port=port, pid=pid, world=WORLD), cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            ))
+        return procs
+
+    results = run_gloo_world(spawn, timeout=timeout)
     stats, digests = None, []
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rig proc {pid} rc={p.returncode}\n{out[-4000:]}"
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"rig proc {pid} rc={rc}\n{out[-4000:]}"
         assert f"OK proc {pid}" in out, out[-2000:]
         for line in out.splitlines():
             if line.startswith("RIG "):

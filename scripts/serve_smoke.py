@@ -1,0 +1,458 @@
+"""Resilient-serving smoke — the acceptance run of ISSUE 10.
+
+Four legs on the 2-process gloo rig (spawned via the shared
+session-unique-port harness, vescale_tpu.testing):
+
+  train     2 processes x 4 devices: a tiny llama trains a few real adam
+            steps on a ("dp","tp")=(2,4) process-spanning mesh (kernels
+            tp-sharded) and saves params + optimizer state as one
+            distributed checkpoint — the TRAINING artifact every other leg
+            restores from.
+
+  serve@2   the SAME world (2 procs, 8 devices) restores params-only
+            through the elastic preflight onto a replicated serve layout
+            (optimizer chunks never in the template, never read) and runs
+            a fixed probe: prefill + decode logits for known prompts,
+            digested bit-exactly.  Then the COORDINATED serve loop runs an
+            open-loop load with one-rank fault injections (oom on rank 0,
+            request_timeout on rank 1, preemption on rank 0): the control
+            plane must OR-agree every eviction/drain decision, both ranks
+            must exit "preempted" with BYTE-IDENTICAL ledgers.
+
+  serve@1   1 process, 4 devices — a DIFFERENT world: the same restore
+            must classify elastic (reshard-on-load, VSC130 path,
+            LAST_LOAD_STATS.elastic=1) and the probe digest must equal
+            serve@2's BIT-FOR-BIT (train on 2, serve on 1, logits
+            unchanged).  Then the single-host resilience battery: a golden
+            fault-free serve run vs a run under injected request_timeout +
+            slow_decode + oom + preemption — every submitted request ends
+            in exactly one terminal outcome, every COMPLETED request's
+            tokens are bit-identical to golden, the drain exits
+            "preempted" cleanly.
+
+Exit 0 on success.  Wired into scripts/run_test.sh and tier-1 via
+tests/test_serve.py.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRAIN_STEPS = 3
+PROBE_PROMPTS = ((5, 9, 17), (3, 44, 7, 11), (29, 2))
+PROBE_DECODES = 4
+SERVE_FAULTS_2P = "oom:step=4,rank=0;request_timeout:step=5,rank=1;preempt:step=7,rank=0"
+
+
+def _model_cfg():
+    import jax.numpy as jnp
+
+    from vescale_tpu.models.llama import LlamaConfig
+
+    # head_dim 4, KV=8: kv-heads divide both the 8-way and 4-way serve mesh
+    return LlamaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=8,
+        max_position_embeddings=64,
+        dtype=jnp.float32,
+    )
+
+
+def _arrivals(Request, n=6, eos_id=None):
+    """Deterministic open-loop load: request i arrives at step 2*i with a
+    seeded prompt; step deadlines keep the multi-proc leg wall-clock-free."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    out = []
+    for i in range(n):
+        prompt = tuple(int(x) for x in rng.integers(1, 120, 3 + (i % 3)))
+        out.append((2 * i, Request(
+            rid=i, prompt=prompt, max_new_tokens=4 + (i % 2),
+            eos_id=eos_id, deadline_steps=40,
+        )))
+    return out
+
+
+# --------------------------------------------------------------------- child
+def child(root: str, role: str, world: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import vescale_tpu.distributed as vdist
+
+    if world > 1:
+        vdist.initialize()
+    me = jax.process_index()
+    assert jax.process_count() == world
+
+    import jax.numpy as jnp  # noqa: E402
+    from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+    import vescale_tpu.checkpoint as ckpt  # noqa: E402
+    from vescale_tpu.mesh import DeviceMesh  # noqa: E402
+    from vescale_tpu.models.llama import Llama  # noqa: E402
+
+    cfg = _model_cfg()
+    model = Llama(cfg)
+    ckpt_dir = os.path.join(root, "ckpt")
+
+    if role == "train":
+        _train_leg(root, ckpt_dir, cfg, model, me)
+    elif role == "serve":
+        _serve_leg(root, ckpt_dir, cfg, model, me, world)
+    else:
+        raise SystemExit(f"unknown role {role}")
+    print(f"OK proc {me}")
+
+
+def _train_leg(root, ckpt_dir, cfg, model, me) -> None:
+    """Real (tiny) training on the process-spanning ("dp","tp") mesh:
+    tp-sharded kernels, adam, next-token loss — then one distributed
+    checkpoint of params AND optimizer state."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import vescale_tpu.checkpoint as ckpt
+    import vescale_tpu.distributed as vdist
+
+    mesh = vdist.hybrid_device_mesh(("dp", "tp"), ici_shape=(4,), dcn_shape=(jax.process_count(),)) \
+        if jax.process_count() > 1 else None
+    if mesh is None:
+        from vescale_tpu.mesh import DeviceMesh
+
+        mesh = DeviceMesh(("dp", "tp"), (2, 4))
+    jmesh = mesh.jax_mesh
+
+    host_params = jax.tree_util.tree_map(
+        np.asarray,
+        jax.device_get(model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))["params"]),
+    )
+
+    def _placement(path_key: str, leaf):
+        # llama_plan's tp convention, expressed as NamedShardings: column-
+        # parallel q/k/v/gate/up (out dim), row-parallel o/down (in dim),
+        # hidden-sharded embedding, vocab-sharded head, norms replicated
+        if leaf.ndim != 2:
+            return P()
+        if any(s in path_key for s in ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj", "lm_head")):
+            return P(None, "tp")
+        if any(s in path_key for s in ("o_proj", "down_proj")):
+            return P("tp", None)
+        if "embedding" in path_key:
+            return P(None, "tp")
+        return P()
+
+    def _place(tree):
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        leaves = []
+        for kp, leaf in flat:
+            key = "/".join(str(getattr(k, "key", k)) for k in kp)
+            host = np.asarray(leaf)
+            sh = NamedSharding(jmesh, _placement(key, host))
+            leaves.append(jax.make_array_from_callback(host.shape, sh, lambda i, h=host: h[i]))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    params = _place(host_params)
+    tx = optax.adam(1e-2)
+    opt_state = jax.tree_util.tree_map(
+        np.asarray, jax.device_get(tx.init(host_params))
+    )
+    opt_state = _place(opt_state)
+
+    rng = np.random.default_rng(3)
+    toks_np = rng.integers(1, cfg.vocab_size, (4, 17)).astype(np.int32)
+    batch_sh = NamedSharding(jmesh, P("dp", None))
+    toks = jax.make_array_from_callback(toks_np.shape, batch_sh, lambda i: toks_np[i])
+
+    def loss_fn(p, t):
+        logits = model.apply({"params": p}, t[:, :-1])
+        tgt = t[:, 1:]
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(lp, tgt[..., None], axis=-1))
+
+    @jax.jit
+    def step(p, o, t):
+        l, g = jax.value_and_grad(loss_fn)(p, t)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, l
+
+    losses = []
+    for _ in range(TRAIN_STEPS):
+        params, opt_state, loss = step(params, opt_state, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    print(f"train losses {losses[0]:.5f} -> {losses[-1]:.5f}")
+    ckpt.save(ckpt_dir, {"model": params, "optimizer": opt_state})
+    if jax.process_count() > 1:
+        import vescale_tpu.distributed as vdist
+
+        vdist.barrier("serve_smoke_after_save")
+
+
+def _serve_template(cfg, model, jmesh):
+    """Abstract params-only restore template: ShapeDtypeStruct + replicated
+    NamedSharding per leaf — mesh-bearing (so the preflight classifies the
+    cross-world restore as elastic) without materializing anything."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shapes = jax.eval_shape(
+        lambda r: model.init(r, jnp.ones((1, 8), jnp.int32))["params"], jax.random.key(0)
+    )
+    rep = NamedSharding(jmesh, P())
+    return jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep), shapes
+    )
+
+
+def _probe_digest(cfg, mesh, params) -> str:
+    """Bit-exact logits probe: a REPLICATED engine (replicated probe cache
+    too) prefills each probe prompt and decodes PROBE_DECODES greedy
+    tokens, hashing every fp32 logits vector — the cross-world parity
+    surface (train-on-2 -> serve-on-1 must reproduce serve-on-2's bytes)."""
+    import numpy as np
+
+    from vescale_tpu.placements import Replicate
+    from vescale_tpu.serve import KVCacheConfig, PagedKVCache, ServeEngine
+
+    kc = KVCacheConfig(
+        layers=cfg.num_hidden_layers, kv_heads=cfg.num_key_value_heads,
+        head_dim=cfg.head_dim, num_slots=2, page_size=4, pages_per_slot=4,
+    )
+    cache = PagedKVCache(kc, mesh, placements=[Replicate()] * len(mesh.mesh_dim_names))
+    eng = ServeEngine(cfg, mesh, params, cache)
+    h = hashlib.sha256()
+    all_tokens = []
+    for prompt in PROBE_PROMPTS:
+        slot = cache.alloc(len(prompt), PROBE_DECODES)
+        logits = eng.prefill(prompt, slot)
+        cache.commit_prefill(slot, len(prompt))
+        h.update(np.asarray(logits, np.float32).tobytes())
+        toks = [eng.greedy(logits)]
+        for _ in range(PROBE_DECODES - 1):
+            t = [0] * kc.num_slots
+            t[slot] = toks[-1]
+            lg = eng.decode(t)
+            cache.advance(slot)
+            h.update(np.asarray(lg[slot], np.float32).tobytes())
+            toks.append(eng.greedy(lg[slot]))
+        all_tokens.append(toks)
+        cache.free(slot)
+    print(f"PROBE_TOKENS={json.dumps(all_tokens)}")
+    return h.hexdigest()
+
+
+def _ledger_json(res) -> str:
+    rows = {
+        str(rid): {"status": o["status"], "tokens": o["tokens"]}
+        for rid, o in sorted(res.outcomes.items())
+    }
+    return json.dumps({"status": res.status, "outcomes": rows}, sort_keys=True)
+
+
+def _serve_leg(root, ckpt_dir, cfg, model, me, world) -> None:
+    import jax
+    import numpy as np
+
+    import vescale_tpu.checkpoint as ckpt
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.serve import (
+        ContinuousBatchingScheduler,
+        KVCacheConfig,
+        PagedKVCache,
+        Request,
+        ServeEngine,
+        load_params,
+        run_serve_resilient,
+    )
+
+    ndev = len(jax.devices())
+    mesh = DeviceMesh(("tp",), (ndev,))
+
+    # ---- train -> serve handoff: params-only template, elastic preflight
+    template = _serve_template(cfg, model, mesh.jax_mesh)
+    params = load_params(ckpt_dir, template)
+    stats = dict(ckpt.LAST_LOAD_STATS)
+    # the writer mesh was ("dp","tp")=(2,4); every serve world (tp=8 or
+    # tp=4) differs -> the restore must have taken the elastic reshard path
+    assert stats.get("elastic") == 1, stats
+    print(f"elastic_restore=1 files_read={stats['files_read']} bytes_read={stats['bytes_read']}")
+
+    # ---- bit-exact probe (replicated program: identical on any world)
+    digest = _probe_digest(cfg, mesh, params)
+    print(f"PROBE_DIGEST={digest}")
+
+    def build_serving():
+        kc = KVCacheConfig(
+            layers=cfg.num_hidden_layers, kv_heads=cfg.num_key_value_heads,
+            head_dim=cfg.head_dim, num_slots=2, page_size=4, pages_per_slot=4,
+        )
+        cache = PagedKVCache(kc, mesh)  # tp-sharded kv heads
+        eng = ServeEngine(cfg, mesh, params, cache)
+        sched = ContinuousBatchingScheduler(cache, max_queue=8)
+        return eng, sched
+
+    arrivals = _arrivals(Request)
+
+    if world > 1:
+        # ---- coordinated fault leg: one-sided injections must be
+        # OR-agreed into identical decisions on every rank
+        eng, sched = build_serving()
+        res = run_serve_resilient(
+            engine=eng, scheduler=sched, arrivals=arrivals,
+            install_signal_handlers=False, coordinate=True,
+            barrier_timeout_s=60.0,
+        )
+        sched.ledger_check()
+        assert res.status == "preempted", res.status
+        print(f"LEDGER={_ledger_json(res)}")
+        return
+
+    # ---- single-host battery: golden vs faulted
+    from vescale_tpu.resilience import faultsim
+
+    eng, sched = build_serving()
+    golden = run_serve_resilient(
+        engine=eng, scheduler=sched, arrivals=arrivals,
+        install_signal_handlers=False, coordinate=False,
+    )
+    sched.ledger_check()
+    assert golden.status == "completed", golden.status
+    assert all(o["status"] == "completed" for o in golden.outcomes.values()), golden.outcomes
+
+    faultsim.arm(faultsim.parse_schedule(
+        "request_timeout:step=6;slow_decode:step=3,count=2;oom:step=4;preempt:step=9"
+    ))
+    try:
+        eng2, sched2 = build_serving()
+        faulted = run_serve_resilient(
+            engine=eng2, scheduler=sched2, arrivals=arrivals,
+            install_signal_handlers=False, coordinate=False,
+        )
+    finally:
+        fired = dict(faultsim.get_injector().fired_total)
+        faultsim.disarm()
+    sched2.ledger_check()
+    assert faulted.status == "preempted", faulted.status
+    assert fired["request_timeout"] == 1 and fired["oom"] == 1, fired
+    assert fired["slow_decode"] >= 1 and fired["preempt"] == 1, fired
+    assert faulted.counts["timed_out"] >= 1, faulted.counts
+    assert faulted.counts["evicted"] >= 1, faulted.counts
+    # none lost, none duplicated: every submitted request is terminal...
+    statuses = {rid: o["status"] for rid, o in faulted.outcomes.items()}
+    assert set(statuses.values()) <= {"completed", "shed", "timed_out", "preempted_requeue"}, statuses
+    # ...and every COMPLETED request regenerated golden's exact tokens,
+    # through evictions and replays included
+    for rid, o in faulted.outcomes.items():
+        if o["status"] == "completed":
+            assert o["tokens"] == golden.outcomes[rid]["tokens"], (
+                rid, o["tokens"], golden.outcomes[rid]["tokens"]
+            )
+    print(f"RESILIENCE_OK statuses={json.dumps(statuses, sort_keys=True)} "
+          f"counts={json.dumps(faulted.counts, sort_keys=True)}")
+
+
+# -------------------------------------------------------------------- driver
+def run_world(root: str, role: str, world: int, extra_env=None, timeout=420):
+    from vescale_tpu.testing import make_child_env, run_gloo_world
+
+    def spawn(port):
+        procs = []
+        for pid in range(world):
+            env = make_child_env(port, pid, world, scrub=("VESCALE_FAULTSIM",),
+                                 extra=extra_env)
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--child", root, role, str(world)],
+                env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            ))
+        return procs
+
+    # train is the only leg that writes the checkpoint; a transport retry
+    # there restarts from a clean root (serve legs only read)
+    on_retry = (
+        (lambda: shutil.rmtree(os.path.join(root, "ckpt"), ignore_errors=True))
+        if role == "train" else None
+    )
+    return run_gloo_world(spawn, timeout=timeout, on_retry=on_retry)
+
+
+def _grep(out: str, prefix: str) -> str:
+    for line in out.splitlines():
+        if line.startswith(prefix):
+            return line[len(prefix):].strip()
+    raise AssertionError(f"no line starting with {prefix!r} in:\n{out[-2000:]}")
+
+
+def check_run(results, label):
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"{label}: proc {pid} rc={rc}\n{out[-5000:]}"
+        assert f"OK proc {pid}" in out, f"{label}: proc {pid}\n{out[-2000:]}"
+
+
+def main() -> None:
+    sys.path.insert(0, REPO)
+    work = tempfile.mkdtemp(prefix="serve_smoke_")
+    try:
+        t0 = time.monotonic()
+        # ---- train on 2 processes
+        train = run_world(work, "train", world=2)
+        check_run(train, "train")
+
+        # ---- serve on the SAME world (2 procs): probe + coordinated faults
+        s2 = run_world(work, "serve", world=2,
+                       extra_env={"VESCALE_FAULTSIM": SERVE_FAULTS_2P})
+        check_run(s2, "serve@2")
+        d2 = [_grep(out, "PROBE_DIGEST=") for _, out in s2]
+        assert d2[0] == d2[1], f"serve@2 ranks disagree on probe logits: {d2}"
+        ledgers = [_grep(out, "LEDGER=") for _, out in s2]
+        assert ledgers[0] == ledgers[1], (
+            "coordinated serve ledgers diverged:\n" + ledgers[0] + "\n" + ledgers[1]
+        )
+        led = json.loads(ledgers[0])
+        assert led["status"] == "preempted", led
+        for out in (s2[0][1], s2[1][1]):
+            assert "elastic_restore=1" in out
+
+        # ---- serve on a DIFFERENT world (1 proc): parity + fault battery
+        s1 = run_world(work, "serve", world=1)
+        check_run(s1, "serve@1")
+        d1 = _grep(s1[0][1], "PROBE_DIGEST=")
+        assert d1 == d2[0], (
+            f"train-on-2 -> serve-on-1 logits differ from same-mesh restore:\n"
+            f"  serve@1 {d1}\n  serve@2 {d2[0]}"
+        )
+        assert "elastic_restore=1" in s1[0][1]
+        assert "RESILIENCE_OK" in s1[0][1]
+
+        print(
+            "SERVE SMOKE OK: train@2 -> serve@1 logits bit-identical to serve@2, "
+            "coordinated fault ledgers agree, drain exits preempted, "
+            f"no request lost or duplicated ({time.monotonic() - t0:.1f}s)"
+        )
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[2], sys.argv[3], int(sys.argv[4]))
+    else:
+        main()

@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import tempfile
@@ -150,45 +149,30 @@ def child(root: str) -> None:
 
 
 # -------------------------------------------------------------------- driver
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
-
-
 def run_rig(root: str, timeout=420):
-    port = _free_port()
-    procs = []
-    for pid in range(WORLD):
-        env = dict(os.environ)
-        for k in ("VESCALE_COORDINATOR", "VESCALE_NUM_PROCESSES", "VESCALE_PROCESS_ID",
-                  "VESCALE_COST_CALIBRATION"):
-            env.pop(k, None)
-        env.update(
-            JAX_PLATFORMS="cpu",
-            PYTHONPATH=f"{REPO}:{env.get('PYTHONPATH', '')}",
-            VESCALE_COORDINATOR=f"localhost:{port}",
-            VESCALE_NUM_PROCESSES=str(WORLD),
-            VESCALE_PROCESS_ID=str(pid),
-        )
-        flags = [f for f in env.get("XLA_FLAGS", "").split()
-                 if "host_platform_device_count" not in f]
-        env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=4"])
-        procs.append(subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--child", root],
-            env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        ))
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(out)
-    return [(p.returncode, out) for p, out in zip(procs, outs)]
+    """2-proc gloo rig via the shared session-unique-port spawner with one
+    bounded transport-setup retry (the PR-9 flake class); a retry restarts
+    from an empty trace root."""
+    import shutil
+
+    from vescale_tpu.testing import make_child_env, run_gloo_world
+
+    def spawn(port):
+        procs = []
+        for pid in range(WORLD):
+            env = make_child_env(port, pid, WORLD, scrub=("VESCALE_COST_CALIBRATION",))
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--child", root],
+                env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            ))
+        return procs
+
+    def reset():
+        shutil.rmtree(root, ignore_errors=True)
+        os.makedirs(root, exist_ok=True)
+
+    return run_gloo_world(spawn, timeout=timeout, on_retry=reset)
 
 
 def check(failures, ok, label):

@@ -93,8 +93,11 @@ def test_two_process_barrier_timeout(tmp_path):
     rank 0's verdict is asserted: rank 0's post-timeout exit tears the
     coordination service down under the hung stand-in, whose exit status
     is therefore undefined."""
+    # transport_retries=0: rank 1's undefined teardown exit could print
+    # coordination-service noise and be misread as a transport flake
     results = _spawn_two_process_worker(
-        "worker_resilience.py", tmp_path, args=("barrier_timeout",), timeout=120
+        "worker_resilience.py", tmp_path, args=("barrier_timeout",), timeout=120,
+        transport_retries=0,
     )
     rc0, out0 = results[0]
     assert rc0 == 0, f"proc 0 failed:\n{out0[-4000:]}"
@@ -109,6 +112,8 @@ def test_two_process_hang_watchdog_abort_then_resume(tmp_path):
     completes."""
     dump_dir = tmp_path / "wd"
     dump_dir.mkdir()
+    # transport_retries=0: this leg EXPECTS non-zero (watchdog) exits —
+    # abort-path teardown noise must not be misread as a transport flake
     results = _spawn_two_process_worker(
         "worker_resilience.py",
         tmp_path,
@@ -119,6 +124,7 @@ def test_two_process_hang_watchdog_abort_then_resume(tmp_path):
             "VESCALE_WATCHDOG_DIR": str(dump_dir),
         },
         timeout=180,
+        transport_retries=0,
     )
     for pid, (rc, out) in enumerate(results):
         assert rc == WATCHDOG_EXIT, f"proc {pid}: rc={rc}\n{out[-4000:]}"
@@ -128,11 +134,14 @@ def test_two_process_hang_watchdog_abort_then_resume(tmp_path):
     bundle = json.load(open(dumps[0]))
     assert bundle["reason"] == "hang" and bundle["threads"], bundle.keys()
     # restart without the fault: auto-resume from the step-2 commit
+    # (fresh=False: the committed checkpoint is this leg's INPUT — a
+    # transport retry must not wipe it)
     results = _spawn_two_process_worker(
         "worker_resilience.py",
         tmp_path,
         args=("train",),
         extra_env={"EXPECT_RESUME": "1"},
+        fresh=False,
     )
     for pid, (rc, out) in enumerate(results):
         assert rc == 0, f"proc {pid} failed:\n{out[-4000:]}"

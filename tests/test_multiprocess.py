@@ -8,16 +8,13 @@ Mirrors the reference's MultiProcessTestCase strategy
 
 import os
 import pathlib
-import socket
+import shutil
 import subprocess
 import sys
 
 import pytest
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from vescale_tpu.testing import make_child_env, run_gloo_world
 
 
 def _spawn_two_process_worker(
@@ -27,54 +24,50 @@ def _spawn_two_process_worker(
     extra_env=None,
     per_rank_env=None,
     timeout=420,
+    fresh=True,
+    transport_retries=1,
 ):
     """Spawn the 2-process x 4-device CPU rig and collect (returncode, out)
     per rank.  ``extra_env`` applies to both ranks; ``per_rank_env`` is a
     {rank: {var: val}} overlay (the multi-host resilience tests inject
-    faults / skew state on exactly one rank this way)."""
+    faults / skew state on exactly one rank this way).
+
+    Ports come from the shared registry (``vescale_tpu.testing``): unique
+    per spawned world across the whole session, with one bounded retry on
+    a gloo transport-setup failure — the PR-9 elastic-smoke flake class.
+    ``fresh=True`` (from-scratch legs) wipes the checkpoint root before a
+    retry; RESUME legs must pass ``fresh=False`` — their committed
+    checkpoint is the input, not residue.  Legs that EXPECT non-zero exits
+    (hang/abort, barrier timeout) must pass ``transport_retries=0``: the
+    surviving rank's teardown can print coordination-service noise that
+    would misclassify the intended failure as a transport flake."""
     repo = pathlib.Path(__file__).resolve().parent.parent
     worker = repo / "tests" / "multiproc" / worker_name
-    port = _free_port()
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env.update(
-            VESCALE_COORDINATOR=f"localhost:{port}",
-            VESCALE_NUM_PROCESSES="2",
-            VESCALE_PROCESS_ID=str(pid),
-            JAX_PLATFORMS="cpu",
-            PYTHONPATH=f"{repo}:{env.get('PYTHONPATH', '')}",
-        )
-        if extra_env:
-            env.update({k: str(v) for k, v in extra_env.items()})
-        if per_rank_env and pid in per_rank_env:
-            env.update({k: str(v) for k, v in per_rank_env[pid].items()})
-        flags = [
-            f
-            for f in env.get("XLA_FLAGS", "").split()
-            if "host_platform_device_count" not in f
-        ]
-        env["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=4"])
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, str(worker), str(tmp_path / "ckpt"), *map(str, args)],
-                env=env,
-                cwd=str(repo),
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
+    ckpt_root = tmp_path / "ckpt"
+
+    def spawn(port):
+        procs = []
+        for pid in range(2):
+            overlay = dict(extra_env or {})
+            if per_rank_env and pid in per_rank_env:
+                overlay.update(per_rank_env[pid])
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(worker), str(ckpt_root), *map(str, args)],
+                    env=make_child_env(port, pid, 2, extra=overlay),
+                    cwd=str(repo),
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
             )
-        )
-    outs = []
-    for pid, p in enumerate(procs):
-        try:
-            out, _ = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(out)
-    return [(p.returncode, out) for p, out in zip(procs, outs)]
+        return procs
+
+    on_retry = (
+        (lambda: shutil.rmtree(ckpt_root, ignore_errors=True)) if fresh else None
+    )
+    return run_gloo_world(spawn, timeout=timeout, on_retry=on_retry,
+                          transport_retries=transport_retries)
 
 
 def _run_two_process_worker(worker_name: str, tmp_path, args=(), extra_env=None):

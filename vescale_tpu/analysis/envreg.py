@@ -268,6 +268,8 @@ register("VESCALE_FAULTSIM", "str", None,
          'Deterministic fault-injection schedule, e.g. `storage_write:call=3;preempt:step=10` (resilience/faultsim.py grammar).')
 register("VESCALE_FAULTSIM_HANG_S", "float", 3600.0,
          "Stall duration in seconds for the faultsim `hang` kind (watchdog test fodder).")
+register("VESCALE_FAULTSIM_SLOW_DECODE_S", "float", 0.05,
+         "Stall duration in seconds for the faultsim `slow_decode` kind (serve-loop straggler injection).")
 register("VESCALE_WATCHDOG_TIMEOUT", "float", 0.0,
          "Hang-watchdog step-progress deadline in seconds; unset or <=0 disables the watchdog.")
 register("VESCALE_WATCHDOG_ABORT", "bool", True,
@@ -282,6 +284,20 @@ register("VESCALE_ELASTIC_LOADER", "bool", False,
          "Sample the token stream by GLOBAL row index so it is invariant to the (dp_world, per-rank batch) split — required on both runs for an elastic world-size resume (docs/resilience.md).")
 register("VESCALE_ELASTIC_RESTORE", "bool", True,
          "Allow restoring a checkpoint written by a different mesh/world size (reshard-on-load, VSC130); `0` refuses cross-world restores with a VSC132 finding.")
+
+# --- serving ---------------------------------------------------------
+register("VESCALE_SERVE_SLOTS", "int", 8,
+         "Decode-slot count of the serving KV cache (max concurrent in-flight requests; static shapes, so changing it recompiles the decode step).")
+register("VESCALE_SERVE_PAGE_SIZE", "int", 16,
+         "Tokens per KV-cache page (the paged-attention block size).")
+register("VESCALE_SERVE_PAGES_PER_SLOT", "int", 4,
+         "Max pages one request may hold; page_size x pages_per_slot is the serving max sequence length.")
+register("VESCALE_SERVE_MAX_QUEUE", "int", 64,
+         "Bounded admission queue depth; submissions beyond it are shed with a retry-after hint (docs/serving.md).")
+register("VESCALE_SERVE_SLO_TTFT_S", "float", 0.0,
+         "p99 time-to-first-token SLO budget in seconds; while the rolling p99 exceeds it new submissions are shed (0 disables).")
+register("VESCALE_SERVE_DEADLINE_S", "float", 0.0,
+         "Default per-request wall-clock deadline in seconds (timeout cancellation); 0 disables (requests may still carry explicit deadlines).")
 
 # --- trace timeline / cost calibration -------------------------------
 register("VESCALE_COST_CALIBRATION", "str", None,

@@ -48,6 +48,14 @@ Fault kinds and their hook sites:
                     a supervisor can relaunch on a different world size
                     (the elastic-restore test substrate,
                     scripts/elastic_smoke.py)
+  request_timeout   observed by ``run_serve_resilient`` — the oldest
+                    in-flight request's deadline is forced expired at the
+                    decode-step boundary, exercising timeout cancellation
+                    (the request is explicitly rejected, never lost)
+  slow_decode       observed by ``run_serve_resilient`` — the decode step
+                    sleeps ``VESCALE_FAULTSIM_SLOW_DECODE_S`` (default
+                    0.05) seconds, simulating a straggling decode so
+                    latency-SLO shedding and the p99 budget are testable
   ================  ====================================================
 
 Gating contract (the ``telemetry.init()`` pattern): while disarmed the
@@ -89,6 +97,8 @@ KINDS = (
     "oom",
     "hang",
     "resize",
+    "request_timeout",
+    "slow_decode",
 )
 
 # errors raised by `check` per kind; observation-level kinds (nonfinite_loss,

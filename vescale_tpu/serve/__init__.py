@@ -1,0 +1,54 @@
+"""vescale_tpu.serve — continuous-batching inference inside the fault envelope.
+
+ROADMAP item 1: the one-substrate thesis (PAPER.md) applied to serving.
+The KV cache is a DArray with ordinary placements (kv_cache.py), the
+scheduler admits into static decode slots at step boundaries with bounded
+admission + load shedding (scheduler.py), prefill/decode are compiled
+steps over the training param tree reusing the flash-attention path and
+the pipe stage split (engine.py), and ``run_serve_resilient`` (loop.py)
+wraps it all in the SAME watchdog/faultsim/preemption/control-plane
+envelope ``run_resilient`` gives training.
+
+Checkpoint handoff: :func:`load_params` restores a TRAINING checkpoint's
+params (and nothing else — optimizer chunks are never read) onto the
+serving mesh through the elastic preflight, so a 2-rank training run
+serves on 1 rank (or any other shape) with bit-identical logits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .engine import ServeEngine
+from .kv_cache import KVCacheConfig, KVCacheOutOfPages, PagedKVCache
+from .loop import ServeResult, run_serve_resilient
+from .scheduler import ContinuousBatchingScheduler, Request, ShedError
+
+__all__ = [
+    "KVCacheConfig",
+    "KVCacheOutOfPages",
+    "PagedKVCache",
+    "ContinuousBatchingScheduler",
+    "Request",
+    "ShedError",
+    "ServeEngine",
+    "ServeResult",
+    "run_serve_resilient",
+    "load_params",
+]
+
+
+def load_params(path: str, template: Any) -> Dict[str, Any]:
+    """Restore ONLY the params tree of a training checkpoint into the
+    serving layout described by ``template`` (DArray / sharded jax.Array /
+    np leaves — shardings are the contract, as in ``checkpoint.load``).
+
+    The params-only template is the whole trick: ``checkpoint.load`` reads
+    exactly the chunks the template names, so the optimizer state —
+    typically 2x the params in bytes — never touches the wire, and the
+    elastic preflight (VSC130) reshards a differently-shaped writer mesh
+    transparently.  ``checkpoint.LAST_LOAD_STATS['elastic']`` says whether
+    the restore crossed worlds."""
+    from .. import checkpoint as ckpt
+
+    return ckpt.load(path, {"model": template})["model"]

@@ -1,0 +1,353 @@
+"""Serve engine — compiled prefill/decode steps over the paged KV cache.
+
+A functional llama-family forward over the SAME param tree the training
+stack produces (flax ``Llama`` layout: ``embed_tokens`` / ``layers_i`` /
+``norm`` / ``lm_head``), so a training checkpoint restores straight into
+the engine through ``checkpoint.load``'s elastic preflight — no weight
+conversion, no serving-specific checkpoint format.
+
+Two compiled paths, both STATIC-shaped so XLA never retraces as requests
+come and go:
+
+  **prefill** — the prompt padded to the cache's ``max_seq_len`` runs the
+  full stack once, reusing the flash-attention kernel path
+  (``ops.flash_attention``: Pallas on TPU, the same dense fallback the
+  training forward takes off-TPU) and the training ``rotary`` phase math;
+  per-layer K/V land in the slot's reserved pages via one scatter.  The
+  layer stack is partitioned with the pipe engine's stage-split
+  (``pipe.pipe_stage._cuts_by_weight``) into ``num_stages`` separately
+  compiled segments — the cut points a prefill/decode-disaggregated
+  deployment would place its pipeline boundaries on.
+
+  **decode** — one token per active slot: project q/k/v for the new
+  position, scatter k/v into the page the slot's table maps that position
+  to, then paged attention (gather the slot's pages, mask by length,
+  online fp32 softmax).  Inactive slots compute too (static shapes) but
+  write only the reserved null page and their logits are ignored.
+
+Decode is a deterministic function of (params, prompt, cache geometry):
+an evicted-and-replayed request regenerates bit-identical tokens in any
+slot/page assignment, which is what lets the serve loop promise "completed
+or explicitly rejected — never corrupted" under mid-batch faults.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .kv_cache import PagedKVCache
+
+__all__ = ["ServeEngine", "stack_params_check"]
+
+
+def _rmsnorm(x, w, eps):
+    import jax
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    x32 = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + eps)
+    return x32 * w  # caller casts
+
+
+def stack_params_check(params: Dict[str, Any], num_layers: int) -> None:
+    """The engine consumes the UNSTACKED per-layer layout (``layers_i.*``);
+    a ``scan_layers`` checkpoint (stacked ``layers.block.*``) must be
+    unstacked first — fail with the fix named, not a KeyError."""
+    if "layers_0" not in params:
+        if "layers" in params:
+            raise ValueError(
+                "params use the scan_layers stacked layout (layers.block.*); "
+                "serve the unstacked layout (LlamaConfig.scan_layers=False) or "
+                "unstack the leading layer axis before building ServeEngine"
+            )
+        raise ValueError("params have no layers_0 — not a llama-family tree")
+    for l in range(num_layers):
+        if f"layers_{l}" not in params:
+            raise ValueError(f"params missing layers_{l} (num_hidden_layers={num_layers})")
+
+
+class ServeEngine:
+    """Compiled prefill/decode over ``cache``.  ``config`` is the training
+    ``LlamaConfig`` (the one the checkpoint was trained with); ``params``
+    is the flax ``params`` tree (np / jax / DArray leaves — host leaves are
+    replicated onto ``mesh`` once at construction)."""
+
+    def __init__(
+        self,
+        config,
+        mesh,
+        params: Dict[str, Any],
+        cache: PagedKVCache,
+        *,
+        num_stages: int = 1,
+        interpret: Optional[bool] = None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        c = config
+        if cache.config.layers != c.num_hidden_layers:
+            raise ValueError(
+                f"cache has {cache.config.layers} layers, model {c.num_hidden_layers}"
+            )
+        if cache.config.kv_heads != c.num_key_value_heads:
+            raise ValueError(
+                f"cache has {cache.config.kv_heads} kv heads, model {c.num_key_value_heads}"
+            )
+        if cache.config.head_dim != c.head_dim:
+            raise ValueError(f"cache head_dim {cache.config.head_dim} != model {c.head_dim}")
+        if not (1 <= num_stages <= c.num_hidden_layers):
+            raise ValueError(f"num_stages={num_stages} for {c.num_hidden_layers} layers")
+        self.config = c
+        self.mesh = mesh
+        self.cache = cache
+        self.num_stages = num_stages
+        self.interpret = interpret
+        params = _as_tree(params)
+        stack_params_check(params, c.num_hidden_layers)
+        self.params = jax.tree_util.tree_map(self._replicate, params)
+        self.stage_bounds = self._stage_bounds(num_stages)
+        self._positions = np.arange(cache.max_seq_len, dtype=np.int32)[None, :]
+        self._build()
+
+    # ------------------------------------------------------------- params
+    def _replicate(self, leaf):
+        """Host leaves -> mesh-replicated global arrays once, up front (a
+        per-call host transfer would dominate decode)."""
+        import jax
+        import numpy as np
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..darray import DArray
+
+        if isinstance(leaf, DArray):
+            return leaf.data
+        if isinstance(leaf, jax.Array):
+            return leaf
+        host = np.asarray(leaf)
+        sharding = NamedSharding(self.mesh.jax_mesh, P())
+        return jax.make_array_from_callback(host.shape, sharding, lambda idx: host[idx])
+
+    def _stage_bounds(self, num_stages: int) -> List[Tuple[int, int]]:
+        """Contiguous layer ranges balanced by param count — the pipe
+        engine's stage-split math over the decoder stack."""
+        from ..pipe.pipe_stage import _cuts_by_weight
+
+        L = self.config.num_hidden_layers
+        if num_stages == 1:
+            return [(0, L)]
+        weights = []
+        for l in range(L):
+            lp = self.params[f"layers_{l}"]
+            import jax
+
+            weights.append(
+                float(sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(lp)))
+            )
+        cuts = _cuts_by_weight(weights, num_stages)
+        bounds = []
+        lo = 0
+        for cut in list(cuts) + [L]:
+            bounds.append((lo, cut))
+            lo = cut
+        return bounds
+
+    # -------------------------------------------------------------- build
+    def _build(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        c = self.config
+        cache = self.cache
+        S = cache.num_slots
+        Tmax = cache.max_seq_len
+        page = cache.config.page_size
+        Pmax = cache.config.pages_per_slot
+        H, KV, hd = c.num_attention_heads, c.num_key_value_heads, c.head_dim
+        dtype = c.dtype
+        eps = c.rms_norm_eps
+        theta = c.rope_theta
+        scale = 1.0 / math.sqrt(hd)
+        rep_sharding = NamedSharding(self.mesh.jax_mesh, P())
+        cache_sharding = cache.spec.named_sharding()
+        interpret = self.interpret
+
+        from ..models.llama import rotary
+
+        def dense(x, kernel):
+            return x.astype(dtype) @ kernel.astype(dtype)
+
+        def embed(params, tokens):
+            return jnp.take(params["embed_tokens"]["embedding"], tokens, axis=0).astype(dtype)
+
+        def head(params, x):
+            xn = _rmsnorm(x, params["norm"]["weight"], eps).astype(dtype)
+            if c.tie_word_embeddings:
+                logits = xn @ params["embed_tokens"]["embedding"].astype(dtype).T
+            else:
+                logits = dense(xn, params["lm_head"]["kernel"])
+            return logits.astype(jnp.float32)
+
+        def block_prefill(lp, x, positions):
+            """One decoder block over the full padded prompt: returns the
+            residual stream plus this layer's K/V for the cache."""
+            B, T, E = x.shape
+            xn = _rmsnorm(x, lp["input_layernorm"]["weight"], eps).astype(dtype)
+            q = dense(xn, lp["self_attn"]["q_proj"]["kernel"]).reshape(B, T, H, hd)
+            k = dense(xn, lp["self_attn"]["k_proj"]["kernel"]).reshape(B, T, KV, hd)
+            v = dense(xn, lp["self_attn"]["v_proj"]["kernel"]).reshape(B, T, KV, hd)
+            q, k = rotary(q, k, positions, theta)
+            from ..ops.flash_attention import flash_attention
+
+            y = flash_attention(q, k, v, causal=True, interpret=interpret)
+            y = y.reshape(B, T, H * hd)
+            x = x + dense(y, lp["self_attn"]["o_proj"]["kernel"])
+            xn2 = _rmsnorm(x, lp["post_attention_layernorm"]["weight"], eps).astype(dtype)
+            g = dense(xn2, lp["mlp"]["gate_proj"]["kernel"])
+            u = dense(xn2, lp["mlp"]["up_proj"]["kernel"])
+            x = x + dense(jax.nn.silu(g) * u, lp["mlp"]["down_proj"]["kernel"])
+            return x, k[0], v[0]
+
+        def make_stage(lo, hi):
+            def stage(params, x, positions):
+                ks, vs = [], []
+                for l in range(lo, hi):
+                    x, k, v = block_prefill(params[f"layers_{l}"], x, positions)
+                    ks.append(k)
+                    vs.append(v)
+                return x, jnp.stack(ks), jnp.stack(vs)
+
+            return jax.jit(stage)
+
+        self._embed_fn = jax.jit(lambda p, toks: embed(p, toks)[None])
+        self._stage_fns = [make_stage(lo, hi) for lo, hi in self.stage_bounds]
+
+        def head_last(params, x, length):
+            last = jax.lax.dynamic_index_in_dim(x, length - 1, axis=1, keepdims=False)
+            logits = head(params, last)[0]
+            return jax.lax.with_sharding_constraint(logits, rep_sharding)
+
+        self._head_fn = jax.jit(head_last)
+
+        def commit_prefill(kd, vd, k_stack, v_stack, page_row):
+            # (L, Tmax, KV, hd) -> per-page blocks scattered into the pool;
+            # table entries beyond the reserved pages are 0 = the null page
+            kp = k_stack.reshape(c.num_hidden_layers, Pmax, page, KV, hd)
+            vp = v_stack.reshape(c.num_hidden_layers, Pmax, page, KV, hd)
+            kd = kd.at[:, page_row].set(kp.astype(kd.dtype))
+            vd = vd.at[:, page_row].set(vp.astype(vd.dtype))
+            return (
+                jax.lax.with_sharding_constraint(kd, cache_sharding),
+                jax.lax.with_sharding_constraint(vd, cache_sharding),
+            )
+
+        self._commit_fn = jax.jit(commit_prefill, donate_argnums=(0, 1))
+
+        def paged_attention(q, kl, vl, table, valid_len):
+            # q (S,H,hd); kl/vl (N,page,KV,hd); table (S,Pmax); valid (S,)
+            ks = jnp.take(kl, table, axis=0).reshape(S, Tmax, KV, hd)
+            vs = jnp.take(vl, table, axis=0).reshape(S, Tmax, KV, hd)
+            g = H // KV
+            qg = (q.astype(jnp.float32) * scale).reshape(S, KV, g, hd)
+            s = jnp.einsum("skgd,stkd->skgt", qg, ks.astype(jnp.float32))
+            mask = jnp.arange(Tmax, dtype=jnp.int32)[None, :] < valid_len[:, None]
+            s = jnp.where(mask[:, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("skgt,stkd->skgd", p, vs.astype(jnp.float32))
+            return o.reshape(S, H * hd).astype(dtype)
+
+        def decode(params, kd, vd, table, lengths, tokens):
+            x = embed(params, tokens)  # (S, E)
+            pos = lengths  # write position of the new token
+            pg = jnp.take_along_axis(table, (pos // page)[:, None], axis=1)[:, 0]
+            off = pos % page
+            for l in range(c.num_hidden_layers):
+                lp = params[f"layers_{l}"]
+                xn = _rmsnorm(x, lp["input_layernorm"]["weight"], eps).astype(dtype)
+                q = dense(xn, lp["self_attn"]["q_proj"]["kernel"]).reshape(S, 1, H, hd)
+                k = dense(xn, lp["self_attn"]["k_proj"]["kernel"]).reshape(S, 1, KV, hd)
+                v = dense(xn, lp["self_attn"]["v_proj"]["kernel"]).reshape(S, 1, KV, hd)
+                q, k = rotary(q, k, pos[:, None], theta)
+                k1, v1 = k[:, 0], v[:, 0]
+                kd = kd.at[l, pg, off].set(k1.astype(kd.dtype))
+                vd = vd.at[l, pg, off].set(v1.astype(vd.dtype))
+                y = paged_attention(q[:, 0], kd[l], vd[l], table, pos + 1)
+                x = x + dense(y, lp["self_attn"]["o_proj"]["kernel"])
+                xn2 = _rmsnorm(x, lp["post_attention_layernorm"]["weight"], eps).astype(dtype)
+                gt = dense(xn2, lp["mlp"]["gate_proj"]["kernel"])
+                u = dense(xn2, lp["mlp"]["up_proj"]["kernel"])
+                x = x + dense(jax.nn.silu(gt) * u, lp["mlp"]["down_proj"]["kernel"])
+            logits = head(params, x)
+            return (
+                jax.lax.with_sharding_constraint(logits, rep_sharding),
+                jax.lax.with_sharding_constraint(kd, cache_sharding),
+                jax.lax.with_sharding_constraint(vd, cache_sharding),
+            )
+
+        self._decode_fn = jax.jit(decode, donate_argnums=(1, 2))
+
+    # ---------------------------------------------------------------- API
+    def prefill(self, prompt: Sequence[int], slot: int) -> np.ndarray:
+        """Run the prompt through the stack, write its K/V into ``slot``'s
+        reserved pages, and return the next-token logits (fp32, host).
+        One compiled program per stage — shapes are static (prompt padded
+        to ``max_seq_len``), so repeat calls never retrace."""
+        cache = self.cache
+        n = len(prompt)
+        if not (0 < n <= cache.max_seq_len):
+            raise ValueError(f"prompt length {n} not in (0, {cache.max_seq_len}]")
+        toks = np.zeros((cache.max_seq_len,), np.int32)
+        toks[:n] = np.asarray(prompt, np.int32)
+        x = self._embed_fn(self.params, toks)
+        ks, vs = [], []
+        for fn in self._stage_fns:
+            x, k, v = fn(self.params, x, self._positions)
+            ks.append(k)
+            vs.append(v)
+        logits = self._head_fn(self.params, x, np.int32(n))
+        import jax.numpy as jnp
+
+        k_stack = ks[0] if len(ks) == 1 else jnp.concatenate(ks, axis=0)
+        v_stack = vs[0] if len(vs) == 1 else jnp.concatenate(vs, axis=0)
+        page_row = np.ascontiguousarray(cache.page_table[slot])
+        kd, vd = self._commit_fn(cache.k.data, cache.v.data, k_stack, v_stack, page_row)
+        cache.update(kd, vd)
+        return np.asarray(logits)
+
+    def decode(self, tokens: np.ndarray) -> np.ndarray:
+        """One decode step for every slot (inactive slots write only the
+        null page): appends each token's K/V at its slot's current length
+        and returns (num_slots, vocab) fp32 logits for the NEXT position.
+        Callers advance lengths via ``cache.advance`` for slots whose
+        token was real."""
+        cache = self.cache
+        logits, kd, vd = self._decode_fn(
+            self.params,
+            cache.k.data,
+            cache.v.data,
+            cache.table_array(),
+            cache.lengths_array(),
+            np.asarray(tokens, np.int32).reshape(cache.num_slots),
+        )
+        cache.update(kd, vd)
+        return np.asarray(logits)
+
+    @staticmethod
+    def greedy(logits_row: np.ndarray) -> int:
+        """Deterministic greedy sample (ties break to the lowest id)."""
+        return int(np.argmax(logits_row))
+
+
+def _as_tree(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Accept {"params": tree} bundles (the make_train_step convention) or
+    the bare tree."""
+    if isinstance(params, dict) and "params" in params and "embed_tokens" not in params:
+        return params["params"]
+    return params

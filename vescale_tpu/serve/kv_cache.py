@@ -1,0 +1,322 @@
+"""Paged KV cache — the serving working set as a DArray on the mesh.
+
+Decode is memory-bound: the KV cache of every in-flight request IS the
+working set, and continuous batching lives or dies on how it is carved up.
+This module keeps the cache in two stacked DArrays (K and V) of physical
+shape ``(layers, num_pages, page_size, kv_heads, head_dim)`` sharded with
+the EXISTING placement vocabulary (``plan_axes``: kv-heads on "tp",
+replicated elsewhere) — the same substrate training params live on, so the
+redistribute/checkpoint/telemetry machinery applies unchanged
+(arXiv:2211.05322's argument for one placement algebra over a
+serving-specific sharding path).
+
+Paging (vLLM-style): a global pool of fixed-size pages, a host-side free
+list, and a per-slot page table.  Every device-facing shape is STATIC —
+``num_slots`` decode rows, ``pages_per_slot`` table columns — so the
+compiled prefill/decode programs never retrace as requests come and go;
+admission and eviction only rewrite the (data, not shape) page-table and
+length vectors.  Page 0 is reserved as the NULL page: unused table entries
+point at it, keeping gathers in-bounds, and everything read through it is
+masked by the length vector, so its contents never reach a logit.
+
+Host-side state (free lists, page tables, lengths) is plain numpy and
+fully deterministic: allocation pops the lowest free slot and the highest
+free page, so two ranks driving the same request stream hold bit-identical
+tables — the property ``fingerprint()`` exposes to the serve loop's
+control-plane agreement check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import zlib
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["KVCacheConfig", "KVCacheOutOfPages", "PagedKVCache"]
+
+
+class KVCacheOutOfPages(RuntimeError):
+    """The page pool cannot cover the requested tokens — an admission-time
+    capacity verdict (the scheduler sheds or waits), never a mid-decode
+    crash: ``reserve`` is called before any cache byte moves."""
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Static geometry of the paged cache.  ``max_seq_len`` (=
+    ``page_size * pages_per_slot``) bounds prompt + generated tokens per
+    request; ``num_pages`` defaults to one full allotment per slot plus the
+    reserved null page (an intentionally tight pool — set it higher to
+    overcommit slots against typical-shorter-than-max sequences)."""
+
+    layers: int
+    kv_heads: int
+    head_dim: int
+    num_slots: int = 8
+    page_size: int = 16
+    pages_per_slot: int = 4
+    num_pages: Optional[int] = None
+    dtype: Any = None  # default jnp.float32
+
+    def __post_init__(self):
+        if min(self.layers, self.kv_heads, self.head_dim) <= 0:
+            raise ValueError("layers/kv_heads/head_dim must be positive")
+        if min(self.num_slots, self.page_size, self.pages_per_slot) <= 0:
+            raise ValueError("num_slots/page_size/pages_per_slot must be positive")
+        if self.num_pages is not None and self.num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the reserved null page)")
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.page_size * self.pages_per_slot
+
+    @property
+    def pool_pages(self) -> int:
+        # +1: page 0 is reserved (never allocated, masked everywhere)
+        return self.num_pages if self.num_pages is not None else self.num_slots * self.pages_per_slot + 1
+
+    @classmethod
+    def from_env(cls, layers: int, kv_heads: int, head_dim: int, dtype=None) -> "KVCacheConfig":
+        from ..analysis import envreg
+
+        return cls(
+            layers=layers,
+            kv_heads=kv_heads,
+            head_dim=head_dim,
+            num_slots=envreg.get_int("VESCALE_SERVE_SLOTS"),
+            page_size=envreg.get_int("VESCALE_SERVE_PAGE_SIZE"),
+            pages_per_slot=envreg.get_int("VESCALE_SERVE_PAGES_PER_SLOT"),
+            dtype=dtype,
+        )
+
+
+def _zeros_global(spec):
+    """A zero-filled global jax.Array for ``spec`` built shard-by-shard
+    (``make_array_from_callback``) — multi-process safe, unlike an eager
+    ``device_put`` of the logical value onto a process-spanning mesh."""
+    import jax
+
+    sharding = spec.named_sharding()
+    shape = spec.layout().physical_shape
+    dt = np.dtype(spec.dtype)
+    return jax.make_array_from_callback(
+        shape, sharding, lambda idx: np.zeros(_idx_shape(idx, shape), dt)
+    )
+
+
+def _idx_shape(idx, shape) -> Tuple[int, ...]:
+    return tuple(len(range(*s.indices(n))) for s, n in zip(idx, shape))
+
+
+class PagedKVCache:
+    """Slot-allocated paged K/V storage + deterministic host bookkeeping.
+
+    Device side: ``k``/``v`` are DArrays of shape
+    ``(L, num_pages, page_size, KV, hd)``; the engine's compiled steps take
+    ``k.data``/``v.data`` (donated) and the loop re-wraps the outputs via
+    :meth:`update`.  Host side: ``page_table`` (num_slots, pages_per_slot)
+    int32 and ``lengths`` (num_slots,) int32 are the only mutable state —
+    both travel into the compiled steps as DATA, never as shapes.
+    """
+
+    def __init__(self, config: KVCacheConfig, mesh, placements=None):
+        import jax.numpy as jnp
+
+        from ..darray import DArray
+        from ..placements import Shard, plan_axes
+        from ..spec import DArraySpec, TensorMeta
+        from ..telemetry import memtrack as _memtrack
+
+        self.config = config
+        self.mesh = mesh
+        dtype = config.dtype if config.dtype is not None else jnp.float32
+        shape = (
+            config.layers,
+            self.num_pages,
+            config.page_size,
+            config.kv_heads,
+            config.head_dim,
+        )
+        if placements is None:
+            # kv-heads (axis 3) split over the mesh dim NAMED "tp" when it
+            # exists; any other axis name stays replicated — the same
+            # mesh-shape-agnostic convention as llama_plan
+            placements = plan_axes(mesh, tp=Shard(3))
+        tp = next(
+            (mesh.shape[i] for i, p in enumerate(placements) if p.is_shard(3)), 1
+        )
+        if config.kv_heads % max(tp, 1):
+            raise ValueError(
+                f"kv_heads={config.kv_heads} not divisible by the head-sharded "
+                f"mesh extent {tp}"
+            )
+        self.spec = DArraySpec(
+            mesh,
+            tuple(placements),
+            TensorMeta(shape, jnp.dtype(dtype)),
+        )
+        with _memtrack.tagged("kv_cache"):
+            self.k = _memtrack.tag_array(DArray(_zeros_global(self.spec), self.spec))
+            self.v = _memtrack.tag_array(DArray(_zeros_global(self.spec), self.spec))
+        # ---------------------------------------------- host bookkeeping
+        self.page_table = np.zeros((config.num_slots, config.pages_per_slot), np.int32)
+        self.lengths = np.zeros((config.num_slots,), np.int32)
+        self._pages_held = np.zeros((config.num_slots,), np.int32)
+        # pop() takes the HIGHEST page / lowest slot — deterministic across
+        # ranks by construction (the agreement check hashes the result)
+        self._free_pages: List[int] = list(range(1, self.num_pages))
+        self._free_slots: List[int] = sorted(range(config.num_slots), reverse=True)
+        # event-sourced digest: every mutation folds into a running crc, so
+        # fingerprint() is O(1) per step (recomputing over the whole table
+        # made the per-step control exchange cost ~tens of us — measured by
+        # the VESCALE_BENCH=serve overhead rung)
+        self._digest = 0
+        self._tokens_held = 0
+
+    # ------------------------------------------------------------ geometry
+    @property
+    def num_pages(self) -> int:
+        return self.config.pool_pages
+
+    @property
+    def num_slots(self) -> int:
+        return self.config.num_slots
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.config.max_seq_len
+
+    def pages_needed(self, tokens: int) -> int:
+        return max(1, math.ceil(tokens / self.config.page_size))
+
+    def free_slot_count(self) -> int:
+        return len(self._free_slots)
+
+    def free_page_count(self) -> int:
+        return len(self._free_pages)
+
+    def active_slots(self) -> List[int]:
+        return sorted(set(range(self.num_slots)) - set(self._free_slots))
+
+    def can_admit(self, prompt_tokens: int, max_new_tokens: int) -> bool:
+        """Admission-time capacity check against the WHOLE request (prompt +
+        generation budget): admitting on prompt pages alone would turn pool
+        exhaustion into a mid-decode fault for a request we promised to
+        serve."""
+        total = prompt_tokens + max_new_tokens
+        if total > self.max_seq_len:
+            return False
+        return (
+            len(self._free_slots) > 0
+            and self.pages_needed(total) <= len(self._free_pages)
+        )
+
+    def _fold(self, *ints: int) -> None:
+        b = b"".join((v & 0xFFFFFFFF).to_bytes(4, "little") for v in ints)
+        self._digest = zlib.crc32(b, self._digest)
+
+    # ---------------------------------------------------------- allocation
+    def alloc(self, prompt_tokens: int, max_new_tokens: int = 0) -> int:
+        """Reserve a slot + every page the request can ever touch; returns
+        the slot id.  Raises :class:`KVCacheOutOfPages` when the pool
+        cannot cover it (callers gate on :meth:`can_admit`)."""
+        total = prompt_tokens + max_new_tokens
+        if total > self.max_seq_len:
+            raise KVCacheOutOfPages(
+                f"request of {total} tokens exceeds max_seq_len={self.max_seq_len}"
+            )
+        need = self.pages_needed(total)
+        if not self._free_slots or need > len(self._free_pages):
+            raise KVCacheOutOfPages(
+                f"need slot+{need} pages, have {len(self._free_slots)} slots / "
+                f"{len(self._free_pages)} pages free"
+            )
+        slot = self._free_slots.pop()
+        row = self.page_table[slot]
+        row[:] = 0
+        for i in range(need):
+            row[i] = self._free_pages.pop()
+        self._pages_held[slot] = need
+        self.lengths[slot] = 0
+        self._fold(1, slot, need, int(row[0]))
+        return slot
+
+    def commit_prefill(self, slot: int, prompt_tokens: int) -> None:
+        """The prompt's K/V pages were written by the engine: the slot now
+        holds ``prompt_tokens`` positions."""
+        if prompt_tokens > int(self._pages_held[slot]) * self.config.page_size:
+            raise ValueError(f"slot {slot}: prefill {prompt_tokens} exceeds reserved pages")
+        self.lengths[slot] = prompt_tokens
+        self._tokens_held += prompt_tokens
+        self._fold(2, slot, prompt_tokens)
+
+    def advance(self, slot: int) -> None:
+        """One decoded token landed in the cache (position ``lengths``)."""
+        if self.lengths[slot] >= int(self._pages_held[slot]) * self.config.page_size:
+            raise KVCacheOutOfPages(f"slot {slot} is full ({int(self.lengths[slot])} tokens)")
+        self.lengths[slot] += 1
+        self._tokens_held += 1
+
+    def free(self, slot: int) -> None:
+        """Release the slot and return its pages to the pool (eviction,
+        completion, timeout — all the same host-side operation)."""
+        if slot in self._free_slots:
+            return
+        held = int(self._pages_held[slot])
+        # LIFO return keeps the free list a deterministic function of the
+        # alloc/free history (not of dict/set iteration order)
+        for i in range(held - 1, -1, -1):
+            self._free_pages.append(int(self.page_table[slot, i]))
+        self._tokens_held -= int(self.lengths[slot])
+        self._fold(3, slot, held, int(self.lengths[slot]))
+        self.page_table[slot] = 0
+        self.lengths[slot] = 0
+        self._pages_held[slot] = 0
+        self._free_slots.append(slot)
+        self._free_slots.sort(reverse=True)
+
+    def reset(self) -> None:
+        """Return every slot and page to the pool (device bytes stay —
+        stale pages are legal: nothing reads past a slot's length).  Lets a
+        bench/driver reuse one COMPILED engine across runs instead of
+        rebuilding (and recompiling) per run."""
+        for slot in list(self.active_slots()):
+            self.free(slot)
+
+    # ------------------------------------------------------- device plumbing
+    def update(self, k_data, v_data) -> None:
+        """Re-wrap the engine step's donated outputs (same spec: the
+        compiled program preserves the sharding)."""
+        from ..darray import DArray
+
+        self.k = DArray(k_data, self.spec)
+        self.v = DArray(v_data, self.spec)
+
+    def table_array(self) -> np.ndarray:
+        return np.ascontiguousarray(self.page_table)
+
+    def lengths_array(self) -> np.ndarray:
+        return np.ascontiguousarray(self.lengths)
+
+    # ------------------------------------------------------------ agreement
+    def fingerprint(self) -> Tuple[int, ...]:
+        """Host-bookkeeping digest for the serve loop's control-plane
+        agreement: ranks whose slot assignment, page allocation history or
+        lengths diverge must raise before the next decode step can act on
+        the disagreement.  Event-sourced (every alloc/commit/free folds
+        into a running crc; advances keep a token total) so the per-step
+        exchange is O(1), and deliberately EXCLUDES device bytes (the null
+        page legally holds scatter garbage)."""
+        return (
+            self._digest,
+            len(self._free_slots),
+            len(self._free_pages),
+            self._tokens_held,
+        )
+
+    def utilization(self) -> float:
+        usable = self.num_pages - 1
+        return 1.0 - (len(self._free_pages) / usable) if usable else 0.0

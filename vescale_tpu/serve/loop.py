@@ -1,0 +1,360 @@
+"""run_serve_resilient — the serve loop born inside the fault envelope.
+
+The serving analog of ``resilience.loop.run_resilient``: the same
+watchdog heartbeat, faultsim schedule, preemption choreography and PR-5
+control plane wrap a continuous-batching decode loop instead of a train
+step.  Failure playbook (docs/serving.md has the full matrix):
+
+  hung decode            ``beat()`` lands once per decode step; a step that
+                         stops progressing trips the watchdog exactly like
+                         a hung train step — stack dump, flight record,
+                         (optional) abort so the supervisor restarts and
+                         queued clients retry.
+  request deadline       timeout cancellation at the step boundary: the
+                         request is EXPLICITLY rejected (``timed_out``),
+                         its slot and pages freed, the batch marches on.
+  slow decode            injected via faultsim ``slow_decode``; a p99-TTFT
+                         SLO budget turns sustained slowness into load
+                         shedding at admission instead of unbounded queue
+                         growth.
+  OOM mid-batch          the NEWEST admitted request is evicted and
+                         replayed (decode is deterministic: it regenerates
+                         the same tokens later); the batch never crashes.
+  SIGTERM / preemption   stop admitting, DRAIN: in-flight requests decode
+                         to completion (or their deadlines), queued ones
+                         are rejected re-queueable with a retry-after, then
+                         a clean ``status="preempted"`` return.
+  multi-host desync      every rank exchanges [step, flags, scheduler
+                         fingerprint] per step boundary; fault flags
+                         (preempt / oom / request_timeout) are OR-agreed so
+                         one rank's injection drives every rank's eviction
+                         identically, and any divergence in slot
+                         assignment/queue/token counts raises DesyncError
+                         on EVERY rank before the divergent batch decodes.
+
+Accounting contract (asserted by scripts/serve_smoke.py under injected
+faults): every submitted request reaches EXACTLY one terminal outcome —
+``completed`` (with deterministic tokens), ``shed``, ``timed_out`` or
+``preempted_requeue`` — none lost, none duplicated.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..resilience import consistency as _cons
+from ..resilience import faultsim as _fs
+from ..resilience.preempt import PreemptionHandler
+from ..resilience.watchdog import Watchdog
+from .engine import ServeEngine
+from .scheduler import ContinuousBatchingScheduler, Request
+
+__all__ = ["ServeResult", "run_serve_resilient"]
+
+# control-plane vector (fixed width): [magic, step, preempt, oom, rtimeout,
+# wall_mask, draining, then the scheduler fingerprint fields + the
+# sampled-token crc].  preempt/oom/rtimeout/wall_mask are ORs (any rank's
+# fault or clock-local deadline verdict drives every rank identically);
+# everything else must agree or the batch must not decode again.
+_COORD_MAGIC = 0x5E47E
+_OR_FIELDS = ("preempt", "oom", "rtimeout", "wall_mask")
+_COORD_FIELDS = ("coord_magic", "step", "preempt", "oom", "rtimeout", "wall_mask", "draining")
+_FP_FIELDS = (
+    "sched_hash", "queue_len", "active", "cache_hash", "free_slots",
+    "free_pages", "tokens_held", "token_crc",
+)
+
+
+@dataclass
+class ServeResult:
+    status: str  # "completed" | "preempted"
+    steps: int = 0
+    outcomes: Dict[int, Dict[str, Any]] = field(default_factory=dict)
+    counts: Dict[str, int] = field(default_factory=dict)
+    drained: int = 0  # in-flight requests finished during the drain
+    rejected_on_drain: int = 0
+
+
+def run_serve_resilient(
+    *,
+    engine: ServeEngine,
+    scheduler: ContinuousBatchingScheduler,
+    arrivals: Sequence[Tuple[int, Request]],
+    max_steps: int = 100_000,
+    wall_deadline_s: Optional[float] = None,
+    preemption: Optional[PreemptionHandler] = None,
+    install_signal_handlers: bool = True,
+    watchdog: Optional[Watchdog] = None,
+    watchdog_timeout_s: Optional[float] = None,
+    coordinate: Optional[bool] = None,
+    barrier_timeout_s: Optional[float] = None,
+    on_step: Optional[Callable[[int, int], None]] = None,
+) -> ServeResult:
+    """Serve ``arrivals`` (a deterministic open-loop schedule of
+    ``(arrival_step, Request)`` pairs, ascending) to completion under the
+    resilience envelope; returns when every request is terminal
+    ("completed") or a preemption drain finishes ("preempted").
+
+    ``wall_deadline_s`` (default env ``VESCALE_SERVE_DEADLINE_S``, 0=off)
+    cancels any in-flight request that has been decoding longer than the
+    budget; per-request ``deadline_steps`` ride on top deterministically.
+    ``coordinate`` defaults to ``jax.process_count() > 1`` — the PR-5
+    control plane then agrees on every admission/eviction/drain decision.
+
+    The loop never loses a request: a mid-batch fault evicts and REPLAYS
+    the newest request; a drain rejects queued requests re-queueable; a
+    deadline rejects explicitly.  ``ServeResult.outcomes`` is the ledger.
+    """
+    import jax
+
+    from .. import telemetry as _tel
+    from ..analysis import envreg
+
+    if not _fs.is_armed():
+        _fs.arm_from_env()
+    handler = preemption or PreemptionHandler()
+    own_handler = preemption is None
+    if own_handler and install_signal_handlers:
+        handler.install()
+    coord = (jax.process_count() > 1) if coordinate is None else bool(coordinate)
+    if wall_deadline_s is None:
+        wall_deadline_s = envreg.get_float("VESCALE_SERVE_DEADLINE_S") or 0.0
+    if coord and wall_deadline_s and scheduler.cache.num_slots > 63:
+        raise ValueError(
+            "coordinated wall deadlines ride an int64 slot bitmask on the "
+            f"control plane: num_slots={scheduler.cache.num_slots} > 63 — "
+            "use per-request deadline_steps instead"
+        )
+
+    own_wd = False
+    wd = watchdog
+    if wd is None:
+        wd = Watchdog.from_env(timeout_s=watchdog_timeout_s)
+        own_wd = wd is not None
+    if own_wd:
+        wd.start()
+
+    def _beat(step: int, phase: str = "decode") -> None:
+        if wd is not None:
+            wd.beat(step, phase=phase)
+
+    arrivals = sorted(arrivals, key=lambda p: (p[0], p[1].rid))
+    next_arrival = 0
+    token_crc = 0  # running digest of every sampled token (desync tripwire)
+    draining = False
+    result = ServeResult(status="completed")
+    cache = scheduler.cache
+
+    def _event(kind: str, **fields) -> None:
+        _tel.record_event(f"serve_{kind}", **fields)
+
+    def _coordinate(step: int, oom_fired: bool, rt_fired: bool,
+                    wall_mask: int) -> Tuple[bool, bool, bool, int]:
+        """One control-plane allgather: OR the fault/preempt flags and the
+        (rank-local, clock-dependent) wall-deadline slot mask, verify
+        scheduler+cache fingerprints agree.  Raises DesyncError (on every
+        rank — the gathered matrix is identical everywhere) on divergence
+        in slot assignment, queue, page tables or sampled tokens."""
+        import numpy as np
+
+        from ..distributed import allgather_ints
+
+        fp = scheduler.fingerprint()
+        vec = [
+            _COORD_MAGIC,
+            step,
+            1 if handler.requested() else 0,
+            1 if oom_fired else 0,
+            1 if rt_fired else 0,
+            wall_mask,
+            1 if draining else 0,
+            *[int(v) & 0x7FFFFFFF for v in fp],
+            token_crc & 0x7FFFFFFF,
+        ]
+        rows = allgather_ints(vec, tag="serve_coord", timeout_s=barrier_timeout_s)
+        if rows.shape[0] == 1:
+            return bool(vec[2]), oom_fired, rt_fired, wall_mask
+        preempt_any = bool(rows[:, 2].any())
+        oom_any = bool(rows[:, 3].any())
+        rt_any = bool(rows[:, 4].any())
+        wall_any = int(np.bitwise_or.reduce(rows[:, 5]))
+        fields = _COORD_FIELDS + _FP_FIELDS[: len(fp)] + ("token_crc",)
+        mismatched = _cons.compare_rows(rows[:, : len(fields)], fields)
+        for f in _OR_FIELDS:
+            mismatched.pop(f, None)
+        if mismatched:
+            _tel.count("consistency_mismatches_total")
+            _event("desync", at_step=step, fields=sorted(mismatched))
+            raise _cons.DesyncError(mismatched, rows)
+        if preempt_any and not handler.requested():
+            handler.request()  # a PEER is being preempted; drain together
+        return preempt_any, oom_any, rt_any, wall_any
+
+    def _prefill_admitted(step: int) -> None:
+        """Admit queued requests into free slots and prefill them; the
+        first sampled token is recorded immediately (its latency IS the
+        TTFT)."""
+        admitted = scheduler.admit(step)
+        for inf in admitted:
+            _beat(step, "prefill")
+            inf.admit_wall = time.perf_counter()
+            logits = engine.prefill(inf.req.prompt, inf.slot)
+            cache.commit_prefill(inf.slot, len(inf.req.prompt))
+            tok = engine.greedy(logits)
+            _sample(inf.slot, tok)
+            # TTFT anchors at SUBMISSION: under load the queue wait is the
+            # dominant term, and the SLO shed path must see it
+            ttft = time.perf_counter() - inf.submit_wall
+            scheduler.observe_ttft(ttft)
+            _event("admit", rid=inf.req.rid, slot=inf.slot, at_step=step,
+                   replays=inf.replays, ttft_s=round(ttft, 6))
+
+    def _sample(slot: int, token: int) -> None:
+        nonlocal token_crc
+        scheduler.record_token(slot, token)
+        token_crc = zlib.crc32(int(token).to_bytes(4, "little", signed=False), token_crc)
+
+    def _finish_done(step: int) -> None:
+        """Complete slots that hit EOS or their token budget."""
+        for slot in sorted(list(scheduler.active)):
+            inf = scheduler.active[slot]
+            done = len(inf.tokens) >= inf.req.max_new_tokens or (
+                inf.req.eos_id is not None and inf.tokens and inf.tokens[-1] == inf.req.eos_id
+            )
+            if done:
+                scheduler.complete(slot)
+                _event("complete", rid=inf.req.rid, slot=slot, at_step=step,
+                       tokens=len(inf.tokens))
+
+    step = 0
+    try:
+        while True:
+            if step >= max_steps:
+                raise RuntimeError(
+                    f"serve loop exceeded max_steps={max_steps} with "
+                    f"{len(scheduler.queue)} queued / {len(scheduler.active)} active"
+                )
+            _fs.set_step(step)
+            _beat(step, "boundary")
+            if _fs.fires("hang", ctx=f"serve_step{step}"):
+                # wedged decode: stall past every deadline — the watchdog's
+                # detect/dump/abort path is the only way out, as in training
+                time.sleep(envreg.get_float("VESCALE_FAULTSIM_HANG_S"))
+            if _fs.fires("preempt", ctx=f"serve_step{step}"):
+                handler.request()
+            oom_fired = _fs.fires("oom", ctx=f"serve_step{step}")
+            rt_fired = _fs.fires("request_timeout", ctx=f"serve_step{step}")
+
+            # ------------------------------------------------ arrivals
+            while (
+                not draining
+                and next_arrival < len(arrivals)
+                and arrivals[next_arrival][0] <= step
+            ):
+                _, req = arrivals[next_arrival]
+                next_arrival += 1
+                scheduler.submit(req, step)
+
+            # ------------------------------------------- control plane
+            # wall-deadline verdicts are rank-LOCAL clock reads: compute
+            # before the exchange so every rank applies the OR-agreed set
+            # (one rank's clock crossing the budget must not desync peers)
+            wall_mask = 0
+            for slot in scheduler.wall_expired_slots(time.perf_counter(), wall_deadline_s):
+                wall_mask |= 1 << slot
+            if coord:
+                preempt_now, oom_fired, rt_fired, wall_mask = _coordinate(
+                    step, oom_fired, rt_fired, wall_mask
+                )
+            else:
+                preempt_now = handler.requested()
+
+            # ------------------------------------------------- faults
+            if oom_fired and scheduler.active:
+                # mid-batch OOM: evict the newest request, replay it later
+                # — the batch survives, nothing is lost
+                victim = scheduler.requeue_newest(reason="injected oom")
+                _event("oom_evict", rid=victim, at_step=step)
+            force_slots: List[int] = []
+            if rt_fired and scheduler.active:
+                # the OLDEST in-flight request's deadline is forced expired
+                force_slots = [min(scheduler.active,
+                                   key=lambda s: (scheduler.active[s].admit_step, s))]
+
+            # ------------------------------------- timeout cancellation
+            scheduler.timeout_queued(step)
+            wall_slots = [s for s in range(cache.num_slots) if wall_mask & (1 << s)]
+            expired = scheduler.expire_active(
+                step, force_slots=force_slots, wall_slots=wall_slots,
+            )
+            for rid in expired:
+                _event("request_timeout", rid=rid, at_step=step)
+
+            # ------------------------------------------------ drain / done
+            if preempt_now and not draining:
+                draining = True
+                _tel.count("resilience_preemptions_total")
+                _event("drain_begin", at_step=step,
+                       inflight=len(scheduler.active), queued=len(scheduler.queue))
+                result.rejected_on_drain = len(scheduler.reject_queued("preempted"))
+            if draining and not scheduler.active:
+                # a mid-drain eviction may have requeued its victim: flush
+                # it as re-queueable too — the ledger must end all-terminal
+                result.rejected_on_drain += len(scheduler.reject_queued("preempted"))
+                result.status = "preempted"
+                break
+            if (
+                not draining
+                and next_arrival >= len(arrivals)
+                and scheduler.all_terminal()
+            ):
+                result.status = "completed"
+                break
+
+            # ---------------------------------------------- admit + decode
+            if not draining:
+                _prefill_admitted(step)
+                # the prefill-sampled token may already satisfy the request
+                # (max_new_tokens=1, or EOS on the first token): complete it
+                # here or the decode below would overrun its token budget
+                _finish_done(step)
+            if scheduler.active:
+                if _fs.fires("slow_decode", ctx=f"serve_step{step}"):
+                    time.sleep(envreg.get_float("VESCALE_FAULTSIM_SLOW_DECODE_S"))
+                _beat(step, "decode")
+                t0 = time.perf_counter()
+                # last sampled token of each active slot feeds this step
+                tokens = [0] * cache.num_slots
+                active_slots = []
+                for slot, inf in scheduler.active.items():
+                    tokens[slot] = inf.tokens[-1]
+                    active_slots.append(slot)
+                logits = engine.decode(tokens)
+                for slot in sorted(active_slots):
+                    cache.advance(slot)
+                    _sample(slot, engine.greedy(logits[slot]))
+                dt = time.perf_counter() - t0
+                scheduler.observe_step_time(dt)
+                _tel.count("serve_decode_steps_total")
+                if draining:
+                    before = scheduler.counts["completed"]
+                    _finish_done(step)
+                    result.drained += scheduler.counts["completed"] - before
+                else:
+                    _finish_done(step)
+            if on_step is not None:
+                on_step(step, len(scheduler.active))
+            step += 1
+    finally:
+        result.steps = step
+        result.outcomes = dict(scheduler.outcomes)
+        result.counts = dict(scheduler.counts)
+        if own_wd:
+            wd.stop()
+        if own_handler and install_signal_handlers:
+            handler.uninstall()
+    _event("serve_done", status=result.status, steps=step, **result.counts)
+    return result

@@ -1,0 +1,448 @@
+"""Continuous-batching scheduler — admission control, slots, load shedding.
+
+Orca/vLLM-style iteration-level scheduling on top of the paged cache: new
+requests are admitted into FREE decode slots at step boundaries (never
+mid-step — the compiled decode program runs whole batches of static
+shape), finished/expired requests are evicted the same way, and the batch
+is re-packed purely by rewriting page-table rows.
+
+Robustness is the design center, not an afterthought:
+
+  * **Bounded queue** — ``submit`` beyond ``max_queue`` is rejected
+    immediately with a ``retry_after_s`` hint (queue depth x observed
+    decode-step time), not buffered until memory or the SLO dies.
+  * **SLO shedding** — while the rolling p99 time-to-first-token exceeds
+    ``slo_ttft_s``, new submissions are shed: an overloaded server that
+    answers some requests inside the SLO beats one that answers all of
+    them late (every shed increments ``resilience_shed_total`` /
+    ``serve_requests_shed_total``).  TTFT anchors at SUBMISSION, so queue
+    wait counts.  NOTE: the p99 is a rank-local wall statistic — on
+    coordinated multi-host replicas leave the SLO at 0 (shed at the
+    frontend); a divergent shed decision raises ``DesyncError`` loudly
+    rather than silently forking the batch (docs/serving.md).
+  * **Total accounting** — every submitted request ends in EXACTLY one
+    terminal outcome (``completed`` / ``shed`` / ``timed_out`` /
+    ``preempted_requeue``); the invariant the serve smoke asserts under
+    fault injection ("none lost, none duplicated").
+
+All decisions are deterministic functions of (request stream, step
+index, capacity): ``fingerprint()`` digests queue + slot assignment so the
+serve loop's cross-rank agreement check catches any divergence before a
+divergent batch can decode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import zlib
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+from .kv_cache import PagedKVCache
+
+__all__ = ["Request", "ShedError", "ContinuousBatchingScheduler"]
+
+TERMINAL = ("completed", "shed", "timed_out", "preempted_requeue")
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request.  ``deadline_steps`` is relative to the
+    submission step (deterministic — the multi-host rig's unit); a wall
+    deadline can ride on top via the loop's ``VESCALE_SERVE_DEADLINE_S``.
+    ``eos_id`` stops generation early; ``max_new_tokens`` always bounds
+    it."""
+
+    rid: int
+    prompt: Tuple[int, ...]
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+    deadline_steps: Optional[int] = None
+
+    def __post_init__(self):
+        if not self.prompt:
+            raise ValueError(f"request {self.rid}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"request {self.rid}: max_new_tokens must be >= 1")
+
+
+class ShedError(RuntimeError):
+    """Raised to a *direct* ``submit(..., raise_on_shed=True)`` caller when
+    admission control rejects the request; carries the retry hint."""
+
+    def __init__(self, rid: int, reason: str, retry_after_s: float):
+        self.rid = rid
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"request {rid} shed ({reason}); retry after ~{retry_after_s:.2f}s"
+        )
+
+
+@dataclasses.dataclass
+class _InFlight:
+    req: Request
+    slot: int
+    submit_step: int
+    admit_step: int
+    submit_wall: float = 0.0  # perf_counter at SUBMISSION (TTFT anchor —
+    # queue wait is the dominant TTFT term under load; kept across replays)
+    admit_wall: float = 0.0  # perf_counter at admission (wall deadlines)
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    replays: int = 0
+
+
+class ContinuousBatchingScheduler:
+    """Queue + slots + outcome ledger.  The serve loop drives it:
+    ``submit`` on arrivals, ``expire`` then ``admit`` at each step
+    boundary, ``record_token`` per decoded token, ``complete`` / ``evict``
+    / ``requeue_newest`` as decode results come back."""
+
+    def __init__(
+        self,
+        cache: PagedKVCache,
+        *,
+        max_queue: Optional[int] = None,
+        slo_ttft_s: Optional[float] = None,
+        ttft_window: int = 256,
+    ):
+        from ..analysis import envreg
+        from ..telemetry.registry import Histogram
+
+        self.cache = cache
+        self.max_queue = (
+            max_queue if max_queue is not None else envreg.get_int("VESCALE_SERVE_MAX_QUEUE")
+        )
+        if slo_ttft_s is None:
+            slo_ttft_s = envreg.get_float("VESCALE_SERVE_SLO_TTFT_S")
+        self.slo_ttft_s = float(slo_ttft_s) if slo_ttft_s else 0.0
+        # (request, submit_step, submit_wall) — the wall stamp anchors TTFT
+        self.queue: Deque[Tuple[Request, int, float]] = deque()
+        self.active: Dict[int, _InFlight] = {}  # slot -> in-flight
+        self.outcomes: Dict[int, Dict[str, Any]] = {}  # rid -> terminal record
+        # own rolling histograms: admission control must work with telemetry
+        # dormant (the registry classes are plain objects, not the gate)
+        self._ttft = Histogram("serve_ttft_seconds", window=ttft_window)
+        self._step_time = Histogram("serve_decode_step_seconds", window=ttft_window)
+        self.counts = {
+            "submitted": 0,
+            "admitted": 0,
+            "completed": 0,
+            "shed": 0,
+            "timed_out": 0,
+            "evicted": 0,
+            "requeued": 0,
+            "resubmitted": 0,
+        }
+        # event-sourced digest: every scheduling decision folds into a
+        # running crc so fingerprint() is O(1) per step boundary (the
+        # control-plane exchange must cost << a decode step)
+        self._digest = 0
+
+    def _fold(self, *ints: int) -> None:
+        self._digest = zlib.crc32(
+            b"".join((v & 0xFFFFFFFF).to_bytes(4, "little") for v in ints), self._digest
+        )
+
+    # ------------------------------------------------------------- metrics
+    def observe_ttft(self, seconds: float) -> None:
+        from .. import telemetry as _tel
+
+        self._ttft.observe(seconds)
+        _tel.observe("serve_ttft_seconds", seconds)
+
+    def observe_step_time(self, seconds: float) -> None:
+        from .. import telemetry as _tel
+
+        self._step_time.observe(seconds)
+        _tel.observe("serve_decode_step_seconds", seconds)
+
+    def ttft_p99(self) -> Optional[float]:
+        return self._ttft.percentile(0.99)
+
+    def retry_after_s(self) -> float:
+        """Backpressure hint: how long until a shed client plausibly finds
+        room — queue depth x observed decode-step p50 (floor 10ms so an
+        unmeasured cold server still says *something* positive)."""
+        p50 = self._step_time.percentile(0.5) or 0.01
+        return max(0.01, (len(self.queue) + 1) * max(p50, 1e-4))
+
+    # ----------------------------------------------------------- admission
+    def submit(self, req: Request, step: int, raise_on_shed: bool = False) -> bool:
+        """Enqueue a request at ``step``; returns False (and records the
+        terminal ``shed`` outcome) when admission control rejects it."""
+        from .. import telemetry as _tel
+
+        if any(r.rid == req.rid for r, _, _ in self.queue) or any(
+            f.req.rid == req.rid for f in self.active.values()
+        ):
+            raise ValueError(f"duplicate request id {req.rid} (still pending)")
+        prior = self.outcomes.get(req.rid)
+        if prior is not None:
+            if prior.get("status") not in TERMINAL:
+                raise ValueError(f"duplicate request id {req.rid} (replay pending)")
+            # the retry_after_s contract: a shed/timed-out/preempted request
+            # MAY come back with the same rid — the new attempt supersedes
+            # the prior terminal outcome (ledger_check nets resubmissions)
+            self.outcomes.pop(req.rid)
+            self.counts["resubmitted"] += 1
+            self._fold(17, req.rid, step)
+        self.counts["submitted"] += 1
+        reason = None
+        if len(self.queue) >= self.max_queue:
+            reason = f"queue full ({len(self.queue)}/{self.max_queue})"
+        elif self.slo_ttft_s > 0:
+            p99 = self.ttft_p99()
+            if p99 is not None and p99 > self.slo_ttft_s:
+                reason = f"p99 TTFT {p99:.3f}s over SLO {self.slo_ttft_s:g}s"
+        total = len(req.prompt) + req.max_new_tokens
+        if reason is None and total > self.cache.max_seq_len:
+            reason = (
+                f"request needs {total} tokens, "
+                f"cache max_seq_len is {self.cache.max_seq_len}"
+            )
+        if reason is None and self.cache.pages_needed(total) > self.cache.num_pages - 1:
+            # could NEVER be admitted even into an empty pool: shedding now
+            # beats blocking the FIFO head forever
+            reason = (
+                f"request needs {self.cache.pages_needed(total)} pages, "
+                f"pool holds {self.cache.num_pages - 1}"
+            )
+        if reason is not None:
+            retry = self.retry_after_s()
+            self.counts["shed"] += 1
+            self.outcomes[req.rid] = {
+                "status": "shed",
+                "reason": reason,
+                "retry_after_s": retry,
+                "tokens": [],
+            }
+            _tel.count("serve_requests_shed_total")
+            _tel.count("resilience_shed_total")
+            _tel.record_event("serve_shed", rid=req.rid, reason=reason, retry_after_s=retry)
+            self._fold(10, req.rid, step)
+            if raise_on_shed:
+                raise ShedError(req.rid, reason, retry)
+            return False
+        self._fold(11, req.rid, step)
+        self.queue.append((req, step, time.perf_counter()))
+        _tel.set_gauge("serve_queue_depth", len(self.queue))
+        return True
+
+    def admit(self, step: int) -> List[_InFlight]:
+        """Fill free slots from the queue head (FIFO — deterministic) at a
+        step boundary; returns the newly admitted in-flight records (the
+        loop prefills them).  A head request the cache cannot hold yet
+        BLOCKS the queue (FIFO fairness: skipping it would starve long
+        requests under a stream of short ones)."""
+        from .. import telemetry as _tel
+
+        admitted: List[_InFlight] = []
+        while self.queue:
+            req, submit_step, submit_wall = self.queue[0]
+            if not self.cache.can_admit(len(req.prompt), req.max_new_tokens):
+                break
+            self.queue.popleft()
+            slot = self.cache.alloc(len(req.prompt), req.max_new_tokens)
+            inf = _InFlight(req=req, slot=slot, submit_step=submit_step,
+                            admit_step=step, submit_wall=submit_wall)
+            prev = self.outcomes.pop(req.rid, None)  # a replayed eviction
+            if prev is not None and prev.get("status") not in ("evicted_replay",):
+                raise RuntimeError(f"request {req.rid} readmitted after terminal {prev}")
+            if prev is not None:
+                inf.replays = int(prev.get("replays", 0)) + 1
+            self.active[slot] = inf
+            self.counts["admitted"] += 1
+            admitted.append(inf)
+            self._fold(12, req.rid, slot, step)
+            _tel.count("serve_requests_admitted_total")
+        _tel.set_gauge("serve_queue_depth", len(self.queue))
+        _tel.set_gauge("serve_inflight", len(self.active))
+        return admitted
+
+    # ------------------------------------------------------------ outcomes
+    def _terminal(self, inf: _InFlight, status: str, **extra) -> None:
+        self.outcomes[inf.req.rid] = {
+            "status": status,
+            "tokens": list(inf.tokens),
+            "replays": inf.replays,
+            **extra,
+        }
+
+    def record_token(self, slot: int, token: int) -> None:
+        self.active[slot].tokens.append(int(token))
+
+    def complete(self, slot: int) -> Dict[str, Any]:
+        """EOS / token budget reached: the request is done."""
+        from .. import telemetry as _tel
+
+        inf = self.active.pop(slot)
+        self.cache.free(slot)
+        self.counts["completed"] += 1
+        self._fold(13, inf.req.rid, slot, len(inf.tokens))
+        self._terminal(inf, "completed")
+        _tel.count("serve_requests_completed_total")
+        _tel.set_gauge("serve_inflight", len(self.active))
+        return self.outcomes[inf.req.rid]
+
+    def timeout(self, slot: int, reason: str = "deadline") -> Dict[str, Any]:
+        """Deadline expired mid-flight: cancel, free the slot, record the
+        EXPLICIT rejection (partial tokens kept for diagnosis)."""
+        from .. import telemetry as _tel
+
+        inf = self.active.pop(slot)
+        self.cache.free(slot)
+        self.counts["timed_out"] += 1
+        self._fold(14, inf.req.rid, slot)
+        self._terminal(inf, "timed_out", reason=reason)
+        _tel.count("serve_requests_timed_out_total")
+        _tel.record_event("serve_timeout", rid=inf.req.rid, slot=slot, reason=reason)
+        _tel.set_gauge("serve_inflight", len(self.active))
+        return self.outcomes[inf.req.rid]
+
+    def timeout_queued(self, step: int) -> List[int]:
+        """Expire queued (never admitted) requests whose step deadline
+        passed while they waited."""
+        from .. import telemetry as _tel
+
+        expired: List[int] = []
+        keep: Deque[Tuple[Request, int, float]] = deque()
+        for req, submit_step, submit_wall in self.queue:
+            d = req.deadline_steps
+            if d is not None and step - submit_step > d:
+                self.counts["timed_out"] += 1
+                self._fold(18, req.rid, step)
+                self.outcomes[req.rid] = {
+                    "status": "timed_out",
+                    "tokens": [],
+                    "replays": 0,
+                    "reason": "queued past deadline",
+                }
+                _tel.count("serve_requests_timed_out_total")
+                _tel.record_event("serve_timeout", rid=req.rid,
+                                  reason="queued past deadline")
+                expired.append(req.rid)
+            else:
+                keep.append((req, submit_step, submit_wall))
+        self.queue = keep
+        if expired:
+            _tel.set_gauge("serve_queue_depth", len(self.queue))
+        return expired
+
+    def requeue_newest(self, reason: str = "oom") -> Optional[int]:
+        """Evict the NEWEST admitted request and replay it from the queue
+        head — the mid-batch OOM protocol: the batch survives, the victim
+        re-prefills later and (decode being deterministic) regenerates the
+        same tokens.  Returns the victim rid, or None with nothing
+        in-flight."""
+        from .. import telemetry as _tel
+
+        if not self.active:
+            return None
+        slot = max(self.active, key=lambda s: (self.active[s].admit_step, s))
+        inf = self.active.pop(slot)
+        self.cache.free(slot)
+        self.counts["evicted"] += 1
+        self.counts["requeued"] += 1
+        self._fold(15, inf.req.rid, slot)
+        # transient marker (NOT terminal): admit() consumes it to count
+        # replays; generation restarts from the prompt
+        self.outcomes[inf.req.rid] = {
+            "status": "evicted_replay",
+            "tokens": [],
+            "replays": inf.replays,
+            "reason": reason,
+        }
+        # the ORIGINAL submit stamps ride along: the replayed request's
+        # TTFT honestly includes everything since the client submitted
+        self.queue.appendleft((inf.req, inf.submit_step, inf.submit_wall))
+        _tel.count("serve_requests_evicted_total")
+        _tel.record_event("serve_evict", rid=inf.req.rid, slot=slot, reason=reason)
+        _tel.set_gauge("serve_inflight", len(self.active))
+        return inf.req.rid
+
+    def reject_queued(self, reason: str = "preempted") -> List[int]:
+        """Drain protocol: every still-queued request is explicitly
+        rejected as re-queueable (the client may resubmit verbatim after
+        the restart) — never silently dropped."""
+        from .. import telemetry as _tel
+
+        rejected = []
+        while self.queue:
+            req, _, _ = self.queue.popleft()
+            self._fold(16, req.rid)
+            self.outcomes[req.rid] = {
+                "status": "preempted_requeue",
+                "tokens": [],
+                "replays": 0,
+                "reason": reason,
+                "retry_after_s": self.retry_after_s(),
+            }
+            self.counts["shed"] += 1
+            _tel.count("serve_requests_shed_total")
+            _tel.count("resilience_shed_total")
+            rejected.append(req.rid)
+        _tel.set_gauge("serve_queue_depth", 0)
+        return rejected
+
+    # ------------------------------------------------------------ expiry
+    def wall_expired_slots(self, now_s: float, wall_deadline_s: float) -> List[int]:
+        """Slots whose request has been in flight longer than the wall
+        budget — computed but NOT applied, so the serve loop can OR-agree
+        the (rank-local, clock-dependent) verdict across ranks before any
+        rank acts on it."""
+        if not wall_deadline_s:
+            return []
+        return [
+            slot for slot in sorted(self.active)
+            if (now_s - self.active[slot].admit_wall) > wall_deadline_s
+        ]
+
+    def expire_active(self, step: int, force_slots: Sequence[int] = (),
+                      wall_slots: Sequence[int] = ()) -> List[int]:
+        """Timeout cancellation at a step boundary: step-deadline expiry,
+        ``wall_slots`` (agreed wall-budget expiries from
+        :meth:`wall_expired_slots`) and ``force_slots`` (the faultsim
+        ``request_timeout`` kind).  Returns the cancelled rids."""
+        out: List[int] = []
+        for slot in sorted(self.active):
+            inf = self.active[slot]
+            d = inf.req.deadline_steps
+            forced = slot in force_slots
+            step_over = d is not None and step - inf.submit_step > d
+            if forced or step_over or slot in wall_slots:
+                reason = "injected request_timeout" if forced else (
+                    "step deadline" if step_over else "wall deadline"
+                )
+                self.timeout(slot, reason=reason)
+                out.append(inf.req.rid)
+        return out
+
+    # ----------------------------------------------------------- agreement
+    def fingerprint(self) -> Tuple[int, ...]:
+        """Deterministic digest of the full scheduling-decision history
+        (every submit/shed/admit/complete/timeout/evict folds into a
+        running crc as it happens — O(1) at exchange time) + the cache's
+        allocation digest: the serve loop exchanges it so slot-assignment
+        divergence raises as a DesyncError BEFORE a divergent batch
+        decodes."""
+        return (self._digest, len(self.queue), len(self.active)) + self.cache.fingerprint()
+
+    def all_terminal(self) -> bool:
+        return not self.queue and not self.active
+
+    def ledger_check(self) -> None:
+        """Assert total accounting: every accepted submission ended exactly
+        one way (a resubmission supersedes its prior terminal outcome, so
+        distinct outcomes == submissions minus resubmissions)."""
+        terminal = [r for r in self.outcomes.values() if r.get("status") in TERMINAL]
+        if self.queue or self.active:
+            raise AssertionError("ledger_check before drain")
+        expected = self.counts["submitted"] - self.counts["resubmitted"]
+        if len(terminal) != expected:
+            raise AssertionError(
+                f"{self.counts['submitted']} submitted "
+                f"({self.counts['resubmitted']} resubmissions) but "
+                f"{len(terminal)} terminal outcomes"
+            )

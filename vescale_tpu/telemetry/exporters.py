@@ -157,23 +157,28 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     # fraction in `trace:`; the extracted chain's numbers in `critical-path:`
     _tr = ("trace_",)
     _cp = ("critical_path_",)
+    # serving block: the continuous-batching loop's request ledger +
+    # in-flight/queue gauges (serve/scheduler.py + serve/loop.py feed)
+    _sv = ("serve_",)
     res_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_res)}
     qc_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_qc)}
     tr_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_tr)}
     cp_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_cp)}
+    sv_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_sv)}
     other_gauges = {
         n: v
         for n, v in snap["gauges"].items()
-        if not n.startswith(("mem_",) + _res + _qc + _tr + _cp)
+        if not n.startswith(("mem_",) + _res + _qc + _tr + _cp + _sv)
     }
     res_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_res)}
     qc_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_qc)}
     tr_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_tr)}
     cp_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_cp)}
+    sv_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_sv)}
     other_counters = {
         n: v
         for n, v in snap["counters"].items()
-        if not n.startswith(_res + _qc + _tr + _cp)
+        if not n.startswith(_res + _qc + _tr + _cp + _sv)
     }
     if other_counters:
         lines.append("counters:")
@@ -207,6 +212,14 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
             lines.append(f"  {name:<48} {_fmt(cp_counters[name]):>12}")
         for name in sorted(cp_gauges):
             lines.append(f"  {name:<48} {cp_gauges[name]:>12.6g}")
+    if sv_counters or sv_gauges:
+        # request ledger of the serve loop: admitted/completed/shed/
+        # timed-out/evicted totals + in-flight and queue-depth gauges
+        lines.append("serving:")
+        for name in sorted(sv_counters):
+            lines.append(f"  {name:<48} {_fmt(sv_counters[name]):>12}")
+        for name in sorted(sv_gauges):
+            lines.append(f"  {name:<48} {sv_gauges[name]:>12.6g}")
     if res_counters or res_gauges:
         # recovery-event block (resilience/loop.py feed, mirrors memory:):
         # a zero-fault run shows armed-but-quiet counters at 0

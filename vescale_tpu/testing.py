@@ -1,0 +1,175 @@
+"""Shared 2-process gloo rig plumbing — ports, spawn, transport retry.
+
+Every multi-process proof in this repo (tests/test_multiprocess.py,
+tests/test_multihost_resilience.py, scripts/elastic_smoke.py,
+scripts/serve_smoke.py) spawns a 2-process x N-virtual-device CPU world
+over `jax.distributed` + gloo.  Each used to pick its coordinator port
+independently with a bind-then-close probe, which has a classic race: the
+probe closes the socket before any child binds it, so a full tier-1 run —
+many rigs starting within the same second — occasionally hands two worlds
+the same port, or hands a port still in TIME_WAIT from a previous rig.
+The result was the PR-9 flake: `elastic_smoke` failing ~once per full run
+with a gloo transport-setup error while passing in isolation.
+
+This module is the single place ports come from and worlds get spawned:
+
+  * :func:`reserve_port` never returns a port it has handed out before in
+    this process (a process-global registry, asserted duplicate-free by a
+    tier-1 test) — one rig can no longer collide with another in the same
+    test session.
+  * :func:`run_gloo_world` collects a spawned world and, when a child dies
+    with a recognizable TRANSPORT-SETUP signature (address in use,
+    connect-refused, coordination-service timeout), retries the whole
+    world ONCE on a fresh port (``transport_retries`` bounds it) — the
+    cross-session race (another process on the machine grabbing the port)
+    is unobservable from in here, so it is absorbed rather than detected.
+    An ``on_retry`` hook lets callers reset on-disk state (checkpoint
+    roots) between attempts; failures with any other signature surface
+    unchanged — a real assertion error must never be retried into hiding.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "reserve_port",
+    "reserved_ports",
+    "is_transport_error",
+    "make_child_env",
+    "run_gloo_world",
+    "TRANSPORT_ERROR_SIGNATURES",
+]
+
+_RESERVED: List[int] = []
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# stderr/stdout fragments that mean "the WORLD never came up" (socket/
+# coordination-service setup), as opposed to a failure of the code under
+# test.  Deliberately narrow: an assertion failure inside a worker must
+# never match.
+TRANSPORT_ERROR_SIGNATURES = (
+    "Address already in use",
+    "Connection refused",
+    "connectFullMesh",
+    "failed to connect to coordination service",
+    "coordination service is not available",
+    "Gloo connect",
+    "gloo transport",
+    "DEADLINE_EXCEEDED: Barrier timed out waiting for init",
+)
+
+
+def reserve_port() -> int:
+    """A fresh localhost port, never previously returned by this process.
+
+    The OS assigns (bind to port 0); the registry retry makes same-process
+    reuse impossible — the cross-rig collision that produced the PR-9
+    elastic-smoke flake."""
+    for _ in range(128):
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        if port not in _RESERVED:
+            _RESERVED.append(port)
+            return port
+    raise RuntimeError(
+        f"could not reserve an unused port after 128 probes "
+        f"({len(_RESERVED)} already handed out)"
+    )
+
+
+def reserved_ports() -> Tuple[int, ...]:
+    """Every port handed out so far (the no-reuse assertion surface)."""
+    return tuple(_RESERVED)
+
+
+def is_transport_error(output: str) -> bool:
+    return any(sig in output for sig in TRANSPORT_ERROR_SIGNATURES)
+
+
+def make_child_env(
+    port: int,
+    pid: int,
+    world: int,
+    *,
+    device_count: int = 4,
+    scrub: Sequence[str] = (),
+    extra: Optional[Dict] = None,
+) -> Dict[str, str]:
+    """The standard child environment of the CPU gloo rig, built in ONE
+    place: coordinator bootstrap vars scrubbed then (for ``world > 1``)
+    set from ``port``/``pid``, CPU platform + repo PYTHONPATH, and the
+    virtual-device flag rewritten to ``device_count``.  ``scrub`` names
+    extra vars the child must not inherit (a stale ``VESCALE_FAULTSIM``
+    from the parent would inject faults into a leg that expects none);
+    ``extra`` applies last, stringified."""
+    env = dict(os.environ)
+    for k in ("VESCALE_COORDINATOR", "VESCALE_NUM_PROCESSES", "VESCALE_PROCESS_ID",
+              *scrub):
+        env.pop(k, None)
+    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=f"{_REPO}:{env.get('PYTHONPATH', '')}")
+    if world > 1:
+        env.update(
+            VESCALE_COORDINATOR=f"localhost:{port}",
+            VESCALE_NUM_PROCESSES=str(world),
+            VESCALE_PROCESS_ID=str(pid),
+        )
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + [f"--xla_force_host_platform_device_count={device_count}"]
+    )
+    if extra:
+        env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def run_gloo_world(
+    spawn: Callable[[int], Sequence[subprocess.Popen]],
+    *,
+    timeout: float = 420,
+    transport_retries: int = 1,
+    on_retry: Optional[Callable[[], None]] = None,
+) -> List[Tuple[int, str]]:
+    """Spawn a world via ``spawn(port)`` and collect ``(returncode,
+    output)`` per process, retrying transport-setup failures on a fresh
+    port at most ``transport_retries`` times.
+
+    ``spawn`` receives a freshly reserved coordinator port and returns the
+    ``Popen`` handles (stdout piped, stderr folded in — the signature scan
+    reads the combined stream).  On timeout every child is killed and the
+    ``TimeoutExpired`` propagates (a hang is a finding, not a flake)."""
+    attempt = 0
+    while True:
+        port = reserve_port()
+        procs = list(spawn(port))
+        outs: List[str] = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append(out or "")
+        results = [(p.returncode, out) for p, out in zip(procs, outs)]
+        if all(rc == 0 for rc, _ in results):
+            return results
+        transport = any(rc != 0 and is_transport_error(out) for rc, out in results)
+        if transport and attempt < transport_retries:
+            attempt += 1
+            print(
+                f"[gloo-rig] transport setup failed on port {port}; "
+                f"retry {attempt}/{transport_retries} on a fresh port",
+                file=sys.stderr,
+            )
+            if on_retry is not None:
+                on_retry()
+            continue
+        return results
