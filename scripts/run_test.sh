@@ -14,6 +14,8 @@ echo "=== quantized grad-collective smoke (int8 bytes ratio, emulator bit-for-bi
 python scripts/quantcomm_smoke.py || failed=1
 echo "=== trace + calibration smoke (merged perfetto trace, measured planner costs)"
 python scripts/trace_smoke.py || failed=1
+echo "=== pallas kernel smoke (off byte-identity, interpret parity, collective-count invariance)"
+python scripts/kernels_smoke.py || failed=1
 echo "=== resilient serving smoke (train@2 -> serve@1 bit-identical, coordinated faults, drain)"
 python scripts/serve_smoke.py || failed=1
 for f in tests/test_*.py; do

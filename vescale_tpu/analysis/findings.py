@@ -121,6 +121,8 @@ _CODE_DEFS: Tuple[Tuple[str, Severity, str], ...] = (
      "lock/allocation/IO inside a signal-handler frame"),
     ("VSC205", Severity.ERROR,
      "bare except in a retry loop swallows KeyboardInterrupt"),
+    ("VSC206", Severity.ERROR,
+     "direct pallas_call outside vescale_tpu/kernels (kernel dispatch contract)"),
 )
 
 CODES: Dict[str, FindingCode] = {

@@ -21,6 +21,10 @@ Five rules, each a lesson this codebase already paid for once:
   VSC205  no bare ``except:`` (or ``except BaseException:``) without a
           re-raise inside a loop — retry loops that swallow
           ``KeyboardInterrupt`` cannot be Ctrl-C'd out of.
+  VSC206  every ``pallas_call`` lives under ``vescale_tpu/kernels/`` —
+          kernels reached any other way bypass the ``VESCALE_KERNELS``
+          dispatch contract (off-mode byte-identity, interpret-mode
+          parity coverage, dispatch/fallback telemetry; docs/kernels.md).
 
 Plus VSC104 (shared with shardcheck): collective calls under
 rank-divergent ``if``/``while`` conditions — the classic SPMD deadlock.
@@ -95,6 +99,13 @@ class _Lint(ast.NodeVisitor):
         self._handler_names: Set[str] = set()
         self._loop_depth = 0
         self._is_envreg = os.path.basename(filename) == "envreg.py"
+        parts = os.path.normpath(filename).split(os.sep)
+        # exempt ONLY the vescale_tpu/kernels package itself — a nested
+        # .../kernels/ directory elsewhere is still subject to VSC206
+        self._in_kernels = any(
+            a == "vescale_tpu" and b == "kernels"
+            for a, b in zip(parts, parts[1:])
+        )
 
     # ------------------------------------------------------------ plumbing
     def emit(self, code: str, message: str, node: ast.AST) -> None:
@@ -146,6 +157,19 @@ class _Lint(ast.NodeVisitor):
 
     def visit_Call(self, node: ast.Call) -> None:
         dotted = _dotted(node.func)
+        # ------------------------------------------------------- VSC206
+        # any `pallas_call` spelling (pl.pallas_call, pallas.pallas_call,
+        # bare pallas_call) outside the kernels package
+        if not self._in_kernels and (
+            dotted == "pallas_call" or dotted.endswith(".pallas_call")
+        ):
+            self.emit(
+                "VSC206",
+                "direct pallas_call outside vescale_tpu/kernels/ bypasses "
+                "the VESCALE_KERNELS dispatch contract; move the kernel "
+                "into the kernels package and dispatch through it",
+                node,
+            )
         # os.getenv("X") / os.environ.get("X") / os.environ.pop (write-ish: pop allowed)
         if dotted in ("os.getenv", "getenv", "os.environ.get", "environ.get"):
             if node.args and isinstance(node.args[0], ast.Constant) and isinstance(node.args[0].value, str):

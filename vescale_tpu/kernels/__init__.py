@@ -1,0 +1,191 @@
+"""vescale_tpu.kernels — the Pallas kernel layer behind ONE dispatch contract.
+
+Every hand-written TPU kernel in the framework lives in this package and is
+reached through the same three-state knob (``VESCALE_KERNELS``, registered
+in ``analysis.envreg``):
+
+  ``off``        (default) the kernels are never consulted — every caller
+                 takes exactly the XLA path it took before this package
+                 existed, byte-identical (asserted by tests/test_kernels.py).
+  ``interpret``  the Pallas kernels run through the pallas INTERPRETER on
+                 any backend — slow, but it executes the real kernel code
+                 path, so CPU tier-1 exercises the same program a TPU would
+                 compile and parity against the XLA reference is checkable
+                 bit-for-bit (or to the documented ulp bound where fp32
+                 accumulation order differs — docs/kernels.md).
+  ``on``         compiled Pallas kernels on TPU; off-TPU this degrades to
+                 the XLA path (counted as a fallback) rather than crawling
+                 through the interpreter.
+
+Kernels in this package:
+
+  * ``flash_attention``  — online-softmax fused attention (forward +
+    backward); dispatched by ``ops/flash_attention.py``.
+  * ``paged_decode``     — PagedAttention-style serve decode: K/V read
+    straight out of the ``PagedKVCache`` page pool through the per-slot
+    page table (scalar-prefetched BlockSpec index maps), online fp32
+    softmax masked by the slot length — one kernel instead of the
+    gather → masked-softmax → matmul chain; dispatched by
+    ``serve/engine.py``.
+  * ``fused_adamw``      — the adamw_lowmem moment/update elementwise
+    chain as one kernel over (g, m, v); dispatched by
+    ``parallel/optimizer.py``.
+  * ``fused_xent``       — vocab-parallel cross entropy's per-shard
+    sumexp + gold-logit pick + Σlogits in ONE pass over the vocab dim
+    (full logits still never materialized); dispatched by ``loss.py``.
+
+Contract points:
+
+  * Dispatch decisions are HOST-side and live-read: each call site asks
+    :func:`resolve` (or :func:`mode` + the counters) at trace/build time.
+    A jitted program therefore latches the mode at compile time — flip the
+    knob, rebuild/retrace, and the other path compiles.  The serve engine
+    documents the same latch (mode read at ``ServeEngine`` build).
+  * Telemetry: every dispatch decision increments
+    ``kernel_dispatch_<name>_total`` (kernel path taken) or
+    ``kernel_fallback_<name>_total`` (kernel requested but the XLA path
+    ran: off-TPU ``on``, pallas unavailable, unsupported shape), plus the
+    ``kernel_dispatch_total`` / ``kernel_fallback_total`` aggregates.
+    They ride the telemetry registry gate — a run that never calls
+    ``telemetry.init()`` pays one dormant-branch check, nothing else —
+    and render as the dashboard's ``kernels:`` block.
+  * ``vescale-lint`` VSC206 bans direct ``pallas_call`` outside this
+    package, so every kernel stays behind this contract.
+  * :func:`def_partition` is the jax-version compat shim for
+    ``custom_partitioning.def_partition`` shared by every custom-
+    partitioned op (kernel or XLA implementation — one partition rule per
+    op, not one per implementation).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = [
+    "MODES",
+    "mode",
+    "resolve",
+    "record_dispatch",
+    "record_fallback",
+    "def_partition",
+    "has_pallas",
+    "on_tpu",
+    "ulps_at_scale",
+]
+
+MODES = ("off", "interpret", "on")
+
+
+def mode() -> str:
+    """The active ``VESCALE_KERNELS`` mode (live env read via envreg)."""
+    from ..analysis import envreg
+
+    m = (envreg.get_str("VESCALE_KERNELS") or "off").strip().lower()
+    if m not in MODES:
+        raise ValueError(
+            f"VESCALE_KERNELS={m!r}: expected one of {'|'.join(MODES)} "
+            "(see docs/kernels.md)"
+        )
+    return m
+
+
+def has_pallas() -> bool:
+    try:  # pallas imports lazily-safe (TPU-only at compile time)
+        from jax.experimental import pallas as _pl  # noqa: F401
+
+        return True
+    except Exception:  # pragma: no cover
+        return False
+
+
+def on_tpu() -> bool:
+    import jax
+
+    return jax.devices()[0].platform == "tpu"
+
+
+def resolve(name: str) -> Optional[bool]:
+    """One-stop dispatch decision for kernel ``name``.
+
+    Returns ``None`` when the caller must take its XLA path (mode off, no
+    pallas, or ``on`` off-TPU), else the ``interpret=`` flag to pass to the
+    kernel (True under ``interpret`` mode, False for compiled-on-TPU).
+    Counts the decision into the kernel telemetry (no-op while telemetry
+    is dormant).  Call sites with their own late fallbacks (shape checks)
+    should use :func:`mode` + :func:`record_fallback` instead of counting
+    a dispatch they then abandon.
+    """
+    m = mode()
+    if m == "off":
+        return None
+    if not has_pallas():
+        record_fallback(name)
+        return None
+    if m == "interpret":
+        record_dispatch(name)
+        return True
+    if not on_tpu():  # "on" wants compiled kernels; no TPU -> XLA path
+        record_fallback(name)
+        return None
+    record_dispatch(name)
+    return False
+
+
+def record_dispatch(name: str) -> None:
+    """Count one kernel-path dispatch decision (per call site evaluation:
+    once per eager call, once per trace for jitted programs)."""
+    from ..telemetry import api as _telemetry
+
+    _telemetry.count("kernel_dispatch_total")
+    _telemetry.count(f"kernel_dispatch_{name}_total")
+
+
+def record_fallback(name: str) -> None:
+    """Count one requested-but-declined dispatch (the XLA path ran)."""
+    from ..telemetry import api as _telemetry
+
+    _telemetry.count("kernel_fallback_total")
+    _telemetry.count(f"kernel_fallback_{name}_total")
+
+
+def ulps_at_scale(a, b) -> float:
+    """THE parity metric of the kernel layer (docs/kernels.md): max
+    ``|a - b|`` over the fp32 spacing at the reference ``b``'s max
+    magnitude — "off by N representable steps at the tensor's scale", so
+    near-zero elements don't inflate the number.  NaN and signed-Inf
+    patterns must agree exactly: a kernel that overflows to Inf (or
+    drops/creates a NaN) where the reference doesn't returns ``inf``, a
+    parity failure, never an excluded element.  One definition, imported
+    by bench.py, scripts/kernels_smoke.py and tests/test_kernels.py, so
+    the asserted bound cannot drift between them."""
+    import numpy as np
+
+    a64 = np.asarray(a, np.float64).ravel()
+    b64 = np.asarray(b, np.float64).ravel()
+    if (
+        not (np.isnan(a64) == np.isnan(b64)).all()
+        or not (np.isposinf(a64) == np.isposinf(b64)).all()
+        or not (np.isneginf(a64) == np.isneginf(b64)).all()
+    ):
+        return float("inf")
+    fin = np.isfinite(a64) & np.isfinite(b64)
+    if not fin.any():
+        return 0.0
+    step = float(np.spacing(np.float32(np.max(np.abs(b64[fin])) or 1.0)))
+    return float(np.max(np.abs(a64[fin] - b64[fin])) / step)
+
+
+def def_partition(cp, **kwargs) -> None:
+    """``custom_partitioning.def_partition`` across jax versions: newer jax
+    grew ``sharding_rule`` (shardy) and ``need_replication_factors``; jax
+    0.4.x has neither.  Keyword args the installed signature doesn't accept
+    are dropped — the explicit ``partition``/``infer_sharding_from_operands``
+    callbacks (always passed) carry the same contract for GSPMD, so older
+    versions lose nothing but the shardy-path rule.  The same shim idea as
+    ``collectives.shard_map`` (check_vma/check_rep).  Shared by every
+    custom-partitioned op so the kernel and XLA implementations of one op
+    register ONE rule through one code path."""
+    import inspect as _inspect
+
+    params = frozenset(_inspect.signature(type(cp).def_partition).parameters)
+    cp.def_partition(**{k: v for k, v in kwargs.items() if k in params})

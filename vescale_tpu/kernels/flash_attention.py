@@ -1,0 +1,489 @@
+"""Flash attention — the fused Pallas TPU kernels (forward + backward).
+
+The kernel half of ``ops/flash_attention.py`` (which owns dispatch, the
+custom_vjp and the GSPMD partition rule): forward streams K/V blocks
+through the MXU with online-softmax accumulation in fp32 and saves the
+per-row logsumexp; backward runs the standard flash decomposition as two
+kernels (dq over q-blocks; dk/dv over kv-blocks) recomputing probabilities
+from the saved LSE — the T x T score matrix never touches HBM in either
+direction, so activation memory is O(T * D).
+
+Lives under ``vescale_tpu.kernels`` so the dispatch contract (and lint
+rule VSC206) covers it; the entry points here are implementation-only and
+assume the caller already decided kernel-vs-XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is TPU-only at runtime; import lazily-safe
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+__all__ = [
+    "_HAS_PALLAS",
+    "_NEG_INF",
+    "_use_streaming",
+    "_flash_fwd_pallas",
+    "_flash_bwd_pallas",
+]
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/where VPU-safe
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, D)
+    D = q.shape[-1]
+
+    nk_total = seq_len // block_k
+    if causal:
+        last = (qi * block_q + block_q - 1) // block_k + 1
+        nk = jnp.minimum(nk_total, last)
+    else:
+        nk = nk_total
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # (1, block_q, 1) block: trailing singleton satisfies TPU tiling rules
+    lse_ref[0] = (m + jnp.log(l_safe))[:, None]
+
+
+# The resident kernels keep whole-(T, D) K/V (or Q/dO) blocks in VMEM —
+# fastest when they fit (one HBM fetch amortized over the whole inner loop).
+# Past this budget (scoped VMEM is ~16 MB; leave headroom for the compute
+# blocks) the streaming kernels walk the inner loop as a grid dimension with
+# fp32 scratch accumulators instead: VMEM O(block), HBM traffic O(T^2/block)
+# on the streamed side — the standard large-T flash trade.
+_VMEM_RESIDENT_BUDGET = 10 * 1024 * 1024
+
+
+def _use_streaming(T: int, D: int, dtype) -> bool:
+    # two resident (T, D) arrays, double-buffered by the pipeline
+    return 4 * T * D * jnp.dtype(dtype).itemsize > _VMEM_RESIDENT_BUDGET
+
+
+def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                       *, scale, causal, block_q, block_k, seq_len):
+    """Streaming forward: grid (BH, nq, nk) — k/v arrive one block per grid
+    step; online-softmax state lives in VMEM scratch across the nk steps."""
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = seq_len // block_k
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:, 0] = m_new
+
+    if causal:
+        # blocks fully above the diagonal contribute nothing; skip compute
+        # (the DMA for the block still happens — data-independent grid)
+        pl.when(j * block_k <= qi * block_q + block_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(j == nk - 1)
+    def _final():
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:, 0] + jnp.log(l_safe))[:, None]
+
+
+def _flash_fwd_pallas(q3, k3, v3, scale, causal, block_q, block_k, interpret, H, KV,
+                      streaming=None):
+    """q3: (B*H, T, D); k3/v3: (B*KV, T, D) — GQA never materializes the
+    repeated K/V heads; the BlockSpec index map routes each q head to its
+    kv group (rows are consecutive per group, llama repeat convention)."""
+    BH, T, D = q3.shape
+    rep = H // KV
+    if streaming is None:
+        streaming = _use_streaming(T, D, k3.dtype)
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=T)
+    out_shape = (
+        jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
+    )
+    if streaming:
+        kv_row_s = lambda b, i, j: ((b // H) * KV + (b % H) // rep, j, 0)
+        return pl.pallas_call(
+            functools.partial(_fwd_kernel_stream, **kw),
+            out_shape=out_shape,
+            grid=(BH, T // block_q, T // block_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, D), kv_row_s),
+                pl.BlockSpec((1, block_k, D), kv_row_s),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, D), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q3, k3, v3)
+    kv_row = lambda b, i: ((b // H) * KV + (b % H) // rep, 0, 0)
+    grid = (BH, T // block_q)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, **kw),
+        out_shape=out_shape,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), kv_row),
+            pl.BlockSpec((1, T, D), kv_row),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ),
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+# ----------------------------------------------------------------- backward
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]    # (block_q,)
+    delta = delta_ref[0, :, 0]  # (block_q,)
+    D = q.shape[-1]
+    nk_total = seq_len // block_k
+    if causal:
+        last = (qi * block_q + block_q - 1) // block_k + 1
+        nk = jnp.minimum(nk_total, last)
+    else:
+        nk = nk_total
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_len, rep):
+    """Grid (B*KV, T//block_k, rep): the last (fastest) grid dim walks the
+    ``rep`` q heads of this kv group, accumulating into the same dk/dv
+    block (TPU grids run sequentially, so output revisiting is the
+    accumulation pattern) — GQA head reduction without materializing
+    repeated K/V or an (rep, T, D) VMEM slab."""
+    ki = pl.program_id(1)
+    r = pl.program_id(2)
+    k = k_ref[0].astype(jnp.float32)  # (block_k, D)
+    v = v_ref[0].astype(jnp.float32)
+    D = k.shape[-1]
+    nq_total = seq_len // block_q
+    if causal:
+        first = (ki * block_k) // block_q  # earliest q block on/after diagonal
+    else:
+        first = 0
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # (block_q, block_k)
+        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(
+        first, nq_total, body, (jnp.zeros((block_k, D), jnp.float32), jnp.zeros((block_k, D), jnp.float32))
+    )
+    if rep == 1:
+        dk_ref[0] = dk.astype(dk_ref.dtype)
+        dv_ref[0] = dv.astype(dv_ref.dtype)
+    else:
+
+        # rep > 1 outputs are fp32 (cast happens outside the kernel): the
+        # cross-head accumulation must not round through bf16 each step
+        @pl.when(r == 0)
+        def _init():
+            dk_ref[0] = dk
+            dv_ref[0] = dv
+
+        @pl.when(r > 0)
+        def _acc():
+            dk_ref[0] = dk_ref[0] + dk
+            dv_ref[0] = dv_ref[0] + dv
+
+
+def _dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+                      *, scale, causal, block_q, block_k, seq_len):
+    """Streaming dq: grid (BH, nq, nk), dq accumulates in fp32 scratch."""
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = seq_len // block_k
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when(j * block_k <= qi * block_q + block_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(j == nk - 1)
+    def _final():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                       dk_scr, dv_scr, *, scale, causal, block_q, block_k, seq_len, rep):
+    """Streaming dk/dv: grid (B*KV, nk, rep, nq) — k/v blocks stay resident
+    while q/do stream; the GQA head-group reduction accumulates in the same
+    fp32 scratch as the q loop (no fp32 output-revisit pass needed)."""
+    ki = pl.program_id(1)
+    r = pl.program_id(2)
+    i = pl.program_id(3)
+    nq = seq_len // block_q
+
+    @pl.when((r == 0) & (i == 0))
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when(i * block_q + block_q - 1 >= ki * block_k)(compute)
+    else:
+        compute()
+
+    @pl.when((r == rep - 1) & (i == nq - 1))
+    def _final():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q3, k3, v3, o3, do3, lse, scale, causal, block_q, block_k, interpret, H, KV,
+                      streaming=None):
+    BH, T, D = q3.shape
+    rep = H // KV
+    if streaming is None:
+        streaming = _use_streaming(T, D, k3.dtype)
+    if streaming:
+        return _flash_bwd_pallas_stream(
+            q3, k3, v3, o3, do3, lse, scale, causal, block_q, block_k, interpret, H, KV
+        )
+    kv_row = lambda b, i: ((b // H) * KV + (b % H) // rep, 0, 0)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1, keepdims=True)  # (BH, T, 1)
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=T)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **kw),
+        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        grid=(BH, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), kv_row),
+            pl.BlockSpec((1, T, D), kv_row),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    # dk/dv: kv-centric grid; q rows of group g are the consecutive
+    # [g*rep, (g+1)*rep) band, walked by the last grid dim
+    q_row = lambda b, i, r: ((b // KV) * H + (b % KV) * rep + r, 0, 0)
+    kv_blk = lambda b, i, r: (b, i, 0)
+    acc_dtype = k3.dtype if rep == 1 else jnp.float32
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, rep=rep, **kw),
+        out_shape=(
+            jax.ShapeDtypeStruct(k3.shape, acc_dtype),
+            jax.ShapeDtypeStruct(v3.shape, acc_dtype),
+        ),
+        grid=(k3.shape[0], T // block_k, rep),
+        in_specs=[
+            pl.BlockSpec((1, T, D), q_row),
+            pl.BlockSpec((1, block_k, D), kv_blk),
+            pl.BlockSpec((1, block_k, D), kv_blk),
+            pl.BlockSpec((1, T, D), q_row),
+            pl.BlockSpec((1, T, 1), q_row),
+            pl.BlockSpec((1, T, 1), q_row),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, D), kv_blk),
+            pl.BlockSpec((1, block_k, D), kv_blk),
+        ),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+def _flash_bwd_pallas_stream(q3, k3, v3, o3, do3, lse, scale, causal, block_q, block_k,
+                             interpret, H, KV):
+    """Large-T backward: both kernels stream their inner loop as a grid dim
+    (VMEM O(block)); dk/dv accumulate the GQA group reduction in scratch so
+    outputs are native dtype directly."""
+    BH, T, D = q3.shape
+    rep = H // KV
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1, keepdims=True)
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=T)
+    kv_row_s = lambda b, i, j: ((b // H) * KV + (b % H) // rep, j, 0)
+    q_blk_s = lambda b, i, j: (b, i, 0)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel_stream, **kw),
+        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        grid=(BH, T // block_q, T // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), q_blk_s),
+            pl.BlockSpec((1, block_k, D), kv_row_s),
+            pl.BlockSpec((1, block_k, D), kv_row_s),
+            pl.BlockSpec((1, block_q, D), q_blk_s),
+            pl.BlockSpec((1, block_q, 1), q_blk_s),
+            pl.BlockSpec((1, block_q, 1), q_blk_s),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), q_blk_s),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    # q rows of kv group g are the consecutive [g*rep, (g+1)*rep) band
+    q_row_s = lambda b, ki, r, i: ((b // KV) * H + (b % KV) * rep + r, i, 0)
+    kv_blk_s = lambda b, ki, r, i: (b, ki, 0)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel_stream, rep=rep, **kw),
+        out_shape=(
+            jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+            jax.ShapeDtypeStruct(v3.shape, v3.dtype),
+        ),
+        grid=(k3.shape[0], T // block_k, rep, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), q_row_s),
+            pl.BlockSpec((1, block_k, D), kv_blk_s),
+            pl.BlockSpec((1, block_k, D), kv_blk_s),
+            pl.BlockSpec((1, block_q, D), q_row_s),
+            pl.BlockSpec((1, block_q, 1), q_row_s),
+            pl.BlockSpec((1, block_q, 1), q_row_s),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, D), kv_blk_s),
+            pl.BlockSpec((1, block_k, D), kv_blk_s),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv

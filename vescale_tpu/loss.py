@@ -66,10 +66,27 @@ def vocab_parallel_cross_entropy(
     ``vocab_dim_name`` the explicit shard_map path runs: logits' last dim
     sharded over that mesh dim, full logits never materialized (reference
     _log_softmax_handler/_nll_loss_forward_handler, loss.py:151,262).
+
+    With ``VESCALE_KERNELS`` enabled the per-shard heavy pass (sumexp +
+    gold pick + Σlogits) runs as ONE fused Pallas kernel
+    (``kernels.cross_entropy``) — one read of each logit — while the
+    cross-shard pmax/psum (and so the collective count) stay exactly as
+    they are.  ``off`` keeps this function byte-identical to the
+    pre-kernel path.
     """
     V = logits.shape[-1]
+    use = _xent_kernel_mode(V if mesh is None or vocab_dim_name is None
+                            else V // mesh.size(mesh.dim_name(vocab_dim_name)),
+                            logits)
     if mesh is None or vocab_dim_name is None:
         lg = logits.astype(jnp.float32)
+        if use is not None:
+            gmax = jax.lax.stop_gradient(jnp.max(lg, axis=-1))
+            sumexp, picked, sumlg = _xent_parts_nd(lg, targets, gmax, use)
+            logz = gmax + jnp.log(sumexp)
+            if label_smoothing > 0.0:
+                return jnp.mean(logz - (1 - label_smoothing) * picked - label_smoothing * (sumlg / V))
+            return jnp.mean(logz - picked)
         logz = jax.scipy.special.logsumexp(lg, axis=-1)
         gold = jnp.take_along_axis(lg, targets[..., None], axis=-1)[..., 0]
         if label_smoothing > 0.0:
@@ -78,16 +95,53 @@ def vocab_parallel_cross_entropy(
         return jnp.mean(logz - gold)
 
     # the builder returns a jit-wrapped fn cached per (mesh, axis, vocab,
-    # smoothing, rank): eager calls reuse one compilation, traced calls
-    # inline it into the enclosing jit
+    # smoothing, rank, kernel-dispatch): eager calls reuse one compilation,
+    # traced calls inline it into the enclosing jit
     fn = _vocab_parallel_fn(
-        mesh, mesh.dim_name(vocab_dim_name), V, float(label_smoothing), logits.ndim
+        mesh, mesh.dim_name(vocab_dim_name), V, float(label_smoothing), logits.ndim, use
     )
     return fn(logits, targets)
 
 
+def _xent_kernel_mode(shard_v: int, logits) -> Optional[bool]:
+    """Kernel-dispatch decision for the fused cross entropy: None = XLA
+    path, else the interpret flag.  Counted here (the call site), since
+    the shape gate below is a late fallback."""
+    from . import kernels as _kernels
+    from .kernels.cross_entropy import xent_blocks
+
+    kmode = _kernels.mode()
+    if kmode == "off":
+        return None
+    n_rows = 1
+    for d in logits.shape[:-1]:
+        n_rows *= int(d)
+    ok = _kernels.has_pallas() and (kmode == "interpret" or _kernels.on_tpu())
+    if not ok or xent_blocks(n_rows, shard_v) is None:
+        _kernels.record_fallback("fused_xent")
+        return None
+    _kernels.record_dispatch("fused_xent")
+    return kmode == "interpret"
+
+
+def _xent_parts_nd(lg32, idx, gmax, interpret):
+    """Run the one-pass kernel over (..., Vs) rows: flatten the leading
+    dims, launch, restore.  ``idx`` are already-local column ids."""
+    from .kernels.cross_entropy import fused_xent_parts
+
+    lead = lg32.shape[:-1]
+    flat = fused_xent_parts(
+        lg32.reshape(-1, lg32.shape[-1]),
+        idx.reshape(-1),
+        gmax.reshape(-1),
+        interpret,
+    )
+    return tuple(x.reshape(lead) for x in flat)
+
+
 @functools.lru_cache(maxsize=64)
-def _vocab_parallel_fn(mesh: DeviceMesh, ax: str, V: int, label_smoothing: float, ndim: int):
+def _vocab_parallel_fn(mesh: DeviceMesh, ax: str, V: int, label_smoothing: float,
+                       ndim: int, kernel: Optional[bool] = None):
     n = mesh.size(ax)
     shard_v = V // n
 
@@ -101,16 +155,23 @@ def _vocab_parallel_fn(mesh: DeviceMesh, ax: str, V: int, label_smoothing: float
         # pmax has no differentiation rule
         local_max = jnp.max(lg_local, axis=-1)
         gmax = jax.lax.stop_gradient(jax.lax.pmax(jax.lax.stop_gradient(local_max), ax))
-        sumexp = jnp.sum(jnp.exp(lg_local - gmax[..., None]), axis=-1)
+        in_range = (tgt >= lo) & (tgt < lo + shard_v)
+        local_idx = jnp.clip(tgt - lo, 0, shard_v - 1)
+        if kernel is not None:
+            # fused one-pass kernel for the per-shard heavy lifting; the
+            # cross-shard reductions below are IDENTICAL to the XLA path
+            sumexp, picked, sumlg = _xent_parts_nd(lg_local, local_idx, gmax, kernel)
+        else:
+            sumexp = jnp.sum(jnp.exp(lg_local - gmax[..., None]), axis=-1)
+            picked = jnp.take_along_axis(lg_local, local_idx[..., None], axis=-1)[..., 0]
+            sumlg = None
         gsum = jax.lax.psum(sumexp, ax)
         logz = gmax + jnp.log(gsum)
         # gold logit: owned by exactly one shard; psum the masked pick
-        in_range = (tgt >= lo) & (tgt < lo + shard_v)
-        local_idx = jnp.clip(tgt - lo, 0, shard_v - 1)
-        picked = jnp.take_along_axis(lg_local, local_idx[..., None], axis=-1)[..., 0]
         gold = jax.lax.psum(jnp.where(in_range, picked, 0.0), ax)
         if label_smoothing > 0.0:
-            mean_v = jax.lax.psum(jnp.sum(lg_local, axis=-1), ax) / V
+            local_sum = sumlg if sumlg is not None else jnp.sum(lg_local, axis=-1)
+            mean_v = jax.lax.psum(local_sum, ax) / V
             return jnp.mean(logz - (1 - label_smoothing) * gold - label_smoothing * mean_v)
         return jnp.mean(logz - gold)
 

@@ -1,14 +1,28 @@
-"""Flash attention — fused Pallas TPU kernels (forward + backward).
+"""Flash attention — dispatch front-end over the Pallas kernels.
 
-The hot op of every model family (SURVEY §6 ladder).  Forward streams K/V
-blocks through the MXU with online-softmax accumulation in fp32 and saves
-the per-row logsumexp; backward runs the standard flash decomposition as two
-kernels (dq over q-blocks; dk/dv over kv-blocks) recomputing probabilities
-from the saved LSE — the T x T score matrix never touches HBM in either
-direction, so activation memory is O(T * D).
+The hot op of every model family (SURVEY §6 ladder).  This module owns the
+DISPATCH (which implementation runs), the custom_vjp, and the GSPMD
+partition rule; the fused Pallas kernels themselves live in
+``vescale_tpu.kernels.flash_attention`` behind the framework-wide kernel
+contract (``VESCALE_KERNELS``, docs/kernels.md).
 
-Falls back to a pure-jnp implementation off-TPU (and uses the pallas
-interpreter in tests), numerically identical math.
+Two implementations, one op:
+
+  * **pallas** — on TPU (or under ``VESCALE_KERNELS=interpret`` /
+    ``interpret=True`` anywhere): forward streams K/V blocks through the
+    MXU with online-softmax accumulation in fp32 and saves the per-row
+    logsumexp; backward runs the standard flash decomposition as two
+    kernels recomputing probabilities from the saved LSE — the T x T
+    score matrix never touches HBM, activation memory is O(T * D).
+  * **xla** — everywhere else: a plain jnp reference with numerically
+    matching math.  It materializes the O(T^2) score matrix and has none
+    of the kernel's MXU blocking or memory behavior — it is a fallback,
+    not a slow kernel.  With ``VESCALE_KERNELS=off`` (the default) this is
+    the bare ``_dense_ref``, byte-identical to the pre-kernel-layer
+    framework; with a kernel mode enabled the fallback routes through the
+    same custom_vjp + partition rule as the kernel (one rule per op, both
+    implementations — the ``impl`` leg of ``_partitioned_fwd``/``_bwd``)
+    and counts into ``kernel_fallback_flash_attention_total``.
 """
 
 from __future__ import annotations
@@ -20,467 +34,16 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-try:  # pallas is TPU-only at runtime; import lazily-safe
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    _HAS_PALLAS = True
-except Exception:  # pragma: no cover
-    _HAS_PALLAS = False
+from ..kernels import def_partition as _def_partition_shim
+from ..kernels.flash_attention import (  # noqa: F401  (re-exported for tests)
+    _HAS_PALLAS,
+    _NEG_INF,
+    _flash_bwd_pallas,
+    _flash_fwd_pallas,
+    _use_streaming,
+)
 
 __all__ = ["flash_attention", "flash_attention_sharded"]
-
-_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/where VPU-safe
-
-
-# ------------------------------------------------------------------ forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, block_k, seq_len):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, D)
-    D = q.shape[-1]
-
-    nk_total = seq_len // block_k
-    if causal:
-        last = (qi * block_q + block_q - 1) // block_k + 1
-        nk = jnp.minimum(nk_total, last)
-    else:
-        nk = nk_total
-
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, D), jnp.float32)
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
-
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # (1, block_q, 1) block: trailing singleton satisfies TPU tiling rules
-    lse_ref[0] = (m + jnp.log(l_safe))[:, None]
-
-
-# The resident kernels keep whole-(T, D) K/V (or Q/dO) blocks in VMEM —
-# fastest when they fit (one HBM fetch amortized over the whole inner loop).
-# Past this budget (scoped VMEM is ~16 MB; leave headroom for the compute
-# blocks) the streaming kernels walk the inner loop as a grid dimension with
-# fp32 scratch accumulators instead: VMEM O(block), HBM traffic O(T^2/block)
-# on the streamed side — the standard large-T flash trade.
-_VMEM_RESIDENT_BUDGET = 10 * 1024 * 1024
-
-
-def _use_streaming(T: int, D: int, dtype) -> bool:
-    # two resident (T, D) arrays, double-buffered by the pipeline
-    return 4 * T * D * jnp.dtype(dtype).itemsize > _VMEM_RESIDENT_BUDGET
-
-
-def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                       *, scale, causal, block_q, block_k, seq_len):
-    """Streaming forward: grid (BH, nq, nk) — k/v arrive one block per grid
-    step; online-softmax state lives in VMEM scratch across the nk steps."""
-    qi = pl.program_id(1)
-    j = pl.program_id(2)
-    nk = seq_len // block_k
-
-    @pl.when(j == 0)
-    def _init():
-        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
-        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
-        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
-
-    def compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_prev = m_scr[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
-        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        m_scr[:, 0] = m_new
-
-    if causal:
-        # blocks fully above the diagonal contribute nothing; skip compute
-        # (the DMA for the block still happens — data-independent grid)
-        pl.when(j * block_k <= qi * block_q + block_q - 1)(compute)
-    else:
-        compute()
-
-    @pl.when(j == nk - 1)
-    def _final():
-        l = l_scr[:, 0]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, 0] + jnp.log(l_safe))[:, None]
-
-
-def _flash_fwd_pallas(q3, k3, v3, scale, causal, block_q, block_k, interpret, H, KV,
-                      streaming=None):
-    """q3: (B*H, T, D); k3/v3: (B*KV, T, D) — GQA never materializes the
-    repeated K/V heads; the BlockSpec index map routes each q head to its
-    kv group (rows are consecutive per group, llama repeat convention)."""
-    BH, T, D = q3.shape
-    rep = H // KV
-    if streaming is None:
-        streaming = _use_streaming(T, D, k3.dtype)
-    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=T)
-    out_shape = (
-        jax.ShapeDtypeStruct(q3.shape, q3.dtype),
-        jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
-    )
-    if streaming:
-        kv_row_s = lambda b, i, j: ((b // H) * KV + (b % H) // rep, j, 0)
-        return pl.pallas_call(
-            functools.partial(_fwd_kernel_stream, **kw),
-            out_shape=out_shape,
-            grid=(BH, T // block_q, T // block_k),
-            in_specs=[
-                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_k, D), kv_row_s),
-                pl.BlockSpec((1, block_k, D), kv_row_s),
-            ],
-            out_specs=(
-                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((block_q, 1), jnp.float32),
-                pltpu.VMEM((block_q, 1), jnp.float32),
-                pltpu.VMEM((block_q, D), jnp.float32),
-            ],
-            interpret=interpret,
-        )(q3, k3, v3)
-    kv_row = lambda b, i: ((b // H) * KV + (b % H) // rep, 0, 0)
-    grid = (BH, T // block_q)
-    return pl.pallas_call(
-        functools.partial(_fwd_kernel, **kw),
-        out_shape=out_shape,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), kv_row),
-            pl.BlockSpec((1, T, D), kv_row),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-        ),
-        interpret=interpret,
-    )(q3, k3, v3)
-
-
-# ----------------------------------------------------------------- backward
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, causal, block_q, block_k, seq_len):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, :, 0]    # (block_q,)
-    delta = delta_ref[0, :, 0]  # (block_q,)
-    D = q.shape[-1]
-    nk_total = seq_len // block_k
-    if causal:
-        last = (qi * block_q + block_q - 1) // block_k + 1
-        nk = jnp.minimum(nk_total, last)
-    else:
-        nk = nk_total
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-
-    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((block_q, D), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-
-
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_len, rep):
-    """Grid (B*KV, T//block_k, rep): the last (fastest) grid dim walks the
-    ``rep`` q heads of this kv group, accumulating into the same dk/dv
-    block (TPU grids run sequentially, so output revisiting is the
-    accumulation pattern) — GQA head reduction without materializing
-    repeated K/V or an (rep, T, D) VMEM slab."""
-    ki = pl.program_id(1)
-    r = pl.program_id(2)
-    k = k_ref[0].astype(jnp.float32)  # (block_k, D)
-    v = v_ref[0].astype(jnp.float32)
-    D = k.shape[-1]
-    nq_total = seq_len // block_q
-    if causal:
-        first = (ki * block_k) // block_q  # earliest q block on/after diagonal
-    else:
-        first = 0
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
-        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # (block_q, block_k)
-        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return dk_new, dv_new
-
-    dk, dv = jax.lax.fori_loop(
-        first, nq_total, body, (jnp.zeros((block_k, D), jnp.float32), jnp.zeros((block_k, D), jnp.float32))
-    )
-    if rep == 1:
-        dk_ref[0] = dk.astype(dk_ref.dtype)
-        dv_ref[0] = dv.astype(dv_ref.dtype)
-    else:
-
-        # rep > 1 outputs are fp32 (cast happens outside the kernel): the
-        # cross-head accumulation must not round through bf16 each step
-        @pl.when(r == 0)
-        def _init():
-            dk_ref[0] = dk
-            dv_ref[0] = dv
-
-        @pl.when(r > 0)
-        def _acc():
-            dk_ref[0] = dk_ref[0] + dk
-            dv_ref[0] = dv_ref[0] + dv
-
-
-def _dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-                      *, scale, causal, block_q, block_k, seq_len):
-    """Streaming dq: grid (BH, nq, nk), dq accumulates in fp32 scratch."""
-    qi = pl.program_id(1)
-    j = pl.program_id(2)
-    nk = seq_len // block_k
-
-    @pl.when(j == 0)
-    def _init():
-        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
-
-    def compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, :, 0]
-        delta = delta_ref[0, :, 0]
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-
-    if causal:
-        pl.when(j * block_k <= qi * block_q + block_q - 1)(compute)
-    else:
-        compute()
-
-    @pl.when(j == nk - 1)
-    def _final():
-        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
-
-
-def _dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                       dk_scr, dv_scr, *, scale, causal, block_q, block_k, seq_len, rep):
-    """Streaming dk/dv: grid (B*KV, nk, rep, nq) — k/v blocks stay resident
-    while q/do stream; the GQA head-group reduction accumulates in the same
-    fp32 scratch as the q loop (no fp32 output-revisit pass needed)."""
-    ki = pl.program_id(1)
-    r = pl.program_id(2)
-    i = pl.program_id(3)
-    nq = seq_len // block_q
-
-    @pl.when((r == 0) & (i == 0))
-    def _init():
-        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
-        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
-
-    def compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0, :, 0]
-        delta = delta_ref[0, :, 0]
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-
-    if causal:
-        pl.when(i * block_q + block_q - 1 >= ki * block_k)(compute)
-    else:
-        compute()
-
-    @pl.when((r == rep - 1) & (i == nq - 1))
-    def _final():
-        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
-        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
-
-
-def _flash_bwd_pallas(q3, k3, v3, o3, do3, lse, scale, causal, block_q, block_k, interpret, H, KV,
-                      streaming=None):
-    BH, T, D = q3.shape
-    rep = H // KV
-    if streaming is None:
-        streaming = _use_streaming(T, D, k3.dtype)
-    if streaming:
-        return _flash_bwd_pallas_stream(
-            q3, k3, v3, o3, do3, lse, scale, causal, block_q, block_k, interpret, H, KV
-        )
-    kv_row = lambda b, i: ((b // H) * KV + (b % H) // rep, 0, 0)
-    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1, keepdims=True)  # (BH, T, 1)
-    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=T)
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, **kw),
-        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
-        grid=(BH, T // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), kv_row),
-            pl.BlockSpec((1, T, D), kv_row),
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
-    # dk/dv: kv-centric grid; q rows of group g are the consecutive
-    # [g*rep, (g+1)*rep) band, walked by the last grid dim
-    q_row = lambda b, i, r: ((b // KV) * H + (b % KV) * rep + r, 0, 0)
-    kv_blk = lambda b, i, r: (b, i, 0)
-    acc_dtype = k3.dtype if rep == 1 else jnp.float32
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, rep=rep, **kw),
-        out_shape=(
-            jax.ShapeDtypeStruct(k3.shape, acc_dtype),
-            jax.ShapeDtypeStruct(v3.shape, acc_dtype),
-        ),
-        grid=(k3.shape[0], T // block_k, rep),
-        in_specs=[
-            pl.BlockSpec((1, T, D), q_row),
-            pl.BlockSpec((1, block_k, D), kv_blk),
-            pl.BlockSpec((1, block_k, D), kv_blk),
-            pl.BlockSpec((1, T, D), q_row),
-            pl.BlockSpec((1, T, 1), q_row),
-            pl.BlockSpec((1, T, 1), q_row),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_k, D), kv_blk),
-            pl.BlockSpec((1, block_k, D), kv_blk),
-        ),
-        interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
-    return dq, dk.astype(k3.dtype), dv.astype(v3.dtype)
-
-
-def _flash_bwd_pallas_stream(q3, k3, v3, o3, do3, lse, scale, causal, block_q, block_k,
-                             interpret, H, KV):
-    """Large-T backward: both kernels stream their inner loop as a grid dim
-    (VMEM O(block)); dk/dv accumulate the GQA group reduction in scratch so
-    outputs are native dtype directly."""
-    BH, T, D = q3.shape
-    rep = H // KV
-    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1, keepdims=True)
-    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k, seq_len=T)
-    kv_row_s = lambda b, i, j: ((b // H) * KV + (b % H) // rep, j, 0)
-    q_blk_s = lambda b, i, j: (b, i, 0)
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel_stream, **kw),
-        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
-        grid=(BH, T // block_q, T // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), q_blk_s),
-            pl.BlockSpec((1, block_k, D), kv_row_s),
-            pl.BlockSpec((1, block_k, D), kv_row_s),
-            pl.BlockSpec((1, block_q, D), q_blk_s),
-            pl.BlockSpec((1, block_q, 1), q_blk_s),
-            pl.BlockSpec((1, block_q, 1), q_blk_s),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), q_blk_s),
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
-    # q rows of kv group g are the consecutive [g*rep, (g+1)*rep) band
-    q_row_s = lambda b, ki, r, i: ((b // KV) * H + (b % KV) * rep + r, i, 0)
-    kv_blk_s = lambda b, ki, r, i: (b, ki, 0)
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel_stream, rep=rep, **kw),
-        out_shape=(
-            jax.ShapeDtypeStruct(k3.shape, k3.dtype),
-            jax.ShapeDtypeStruct(v3.shape, v3.dtype),
-        ),
-        grid=(k3.shape[0], T // block_k, rep, T // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), q_row_s),
-            pl.BlockSpec((1, block_k, D), kv_blk_s),
-            pl.BlockSpec((1, block_k, D), kv_blk_s),
-            pl.BlockSpec((1, block_q, D), q_row_s),
-            pl.BlockSpec((1, block_q, 1), q_row_s),
-            pl.BlockSpec((1, block_q, 1), q_row_s),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_k, D), kv_blk_s),
-            pl.BlockSpec((1, block_k, D), kv_blk_s),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((block_k, D), jnp.float32),
-            pltpu.VMEM((block_k, D), jnp.float32),
-        ],
-        interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
-    return dq, dk, dv
 
 
 # ---------------------------------------------------------------- reference
@@ -509,9 +72,60 @@ def _from3(x, B, H):
     return jnp.transpose(x.reshape(B, H, T, D), (0, 2, 1, 3))
 
 
-def _fwd_4d(q, k, v, scale, causal, block_q, block_k, interpret):
+def _xla_fwd_4d(q, k, v, scale, causal):
+    """Dense (o, lse) with the kernel's GQA layout and lse convention —
+    the fallback leg of the shared partition rule (mode != off only; the
+    off-mode fallback is the bare ``_dense_ref``)."""
+    B, T, H, D = q.shape
+    G = k.shape[2]
+    rep = H // G
+    qg = q.astype(jnp.float32).reshape(B, T, G, rep, D)
+    s = scale * jnp.einsum("bqgrd,bkgd->bgrqk", qg, k.astype(jnp.float32))
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.einsum("bgrqk,bkgd->bqgrd", p, v.astype(jnp.float32))
+    o = o / jnp.transpose(l_safe, (0, 3, 1, 2))[..., None]
+    lse = (m + jnp.log(l_safe)).reshape(B, H, T)
+    return o.reshape(B, T, H, D).astype(q.dtype), lse
+
+
+def _xla_bwd_4d(q, k, v, o, do, lse, scale, causal):
+    """Dense flash-decomposition backward (probabilities recomputed from
+    the saved LSE — the same math the dq/dkv kernels run, unblocked)."""
+    B, T, H, D = q.shape
+    G = k.shape[2]
+    rep = H // G
+    q32 = q.astype(jnp.float32)
+    k32 = k.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    qg = q32.reshape(B, T, G, rep, D)
+    dog = do32.reshape(B, T, G, rep, D)
+    s = scale * jnp.einsum("bqgrd,bkgd->bgrqk", qg, k32)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+    p = jnp.exp(s - lse.reshape(B, G, rep, T)[..., None])
+    delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)  # (B, T, H)
+    delta_r = jnp.transpose(delta, (0, 2, 1)).reshape(B, G, rep, T)
+    dp = jnp.einsum("bqgrd,bkgd->bgrqk", dog, v32)
+    ds = p * (dp - delta_r[..., None]) * scale
+    dq = jnp.einsum("bgrqk,bkgd->bqgrd", ds, k32).reshape(B, T, H, D).astype(q.dtype)
+    dk = jnp.einsum("bgrqk,bqgrd->bkgd", ds, qg).astype(k.dtype)
+    dv = jnp.einsum("bgrqk,bqgrd->bkgd", p, dog).astype(v.dtype)
+    return dq, dk, dv
+
+
+def _fwd_4d(q, k, v, scale, causal, block_q, block_k, interpret, impl):
     """(B,T,H,D) q + (B,T,G,D) k/v (G | H; GQA stays un-repeated) ->
-    (o (B,T,H,D), lse (B,H,T)) via the pallas kernels."""
+    (o (B,T,H,D), lse (B,H,T)) via the selected implementation."""
+    if impl == "xla":
+        return _xla_fwd_4d(q, k, v, scale, causal)
     B, T, H, D = q.shape
     G = k.shape[2]
     o3, lse3 = _flash_fwd_pallas(
@@ -520,7 +134,9 @@ def _fwd_4d(q, k, v, scale, causal, block_q, block_k, interpret):
     return _from3(o3, B, H), lse3.reshape(B, H, T)
 
 
-def _bwd_4d(q, k, v, o, do, lse, scale, causal, block_q, block_k, interpret):
+def _bwd_4d(q, k, v, o, do, lse, scale, causal, block_q, block_k, interpret, impl):
+    if impl == "xla":
+        return _xla_bwd_4d(q, k, v, o, do, lse, scale, causal)
     B, T, H, D = q.shape
     G = k.shape[2]
     dq3, dk3, dv3 = _flash_bwd_pallas(
@@ -538,23 +154,13 @@ def _bwd_4d(q, k, v, o, do, lse, scale, causal, block_q, block_k, interpret):
 # a trivial rule — shard b and h, replicate t and d, zero communication —
 # registered here via jax.experimental.custom_partitioning so *plain
 # jit+mesh model code* keeps the fused kernel (the shard_map wrapper below
-# remains for explicit use).  Seq-sharded inputs are all-gathered by the
-# need_replication factors; long-context seq sharding belongs to
-# ring/ulysses (parallel/context.py) instead.
-
-
-def _def_partition(cp, **kwargs) -> None:
-    """``custom_partitioning.def_partition`` across jax versions: newer jax
-    grew ``sharding_rule`` (shardy) and ``need_replication_factors``; jax
-    0.4.x has neither.  Keyword args the installed signature doesn't accept
-    are dropped — the explicit ``partition``/``infer_sharding_from_operands``
-    callbacks (always passed) carry the same contract for GSPMD, so older
-    versions lose nothing but the shardy-path rule.  The same shim idea as
-    ``collectives.shard_map`` (check_vma/check_rep)."""
-    import inspect as _inspect
-
-    params = frozenset(_inspect.signature(type(cp).def_partition).parameters)
-    cp.def_partition(**{k: v for k, v in kwargs.items() if k in params})
+# remains for explicit use).  The rule is defined ONCE per op and carries
+# both implementations via the ``impl`` leg — the XLA fallback of an enabled
+# kernel mode partitions exactly like the kernel, through the shared
+# ``kernels.def_partition`` version shim.  Seq-sharded inputs are
+# all-gathered by the need_replication factors; long-context seq sharding
+# belongs to ring/ulysses (parallel/context.py) instead.
+_def_partition = _def_partition_shim  # back-compat alias (pre-kernels name)
 
 
 def _batch_head_axes(mesh, arg_shapes):
@@ -581,13 +187,13 @@ def _batch_head_axes(mesh, arg_shapes):
 
 
 @functools.lru_cache(maxsize=64)
-def _partitioned_fwd(scale, causal, block_q, block_k, interpret):
+def _partitioned_fwd(scale, causal, block_q, block_k, interpret, impl):
     from jax.experimental.custom_partitioning import custom_partitioning
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     @custom_partitioning
     def fwd(q, k, v):
-        return _fwd_4d(q, k, v, scale, causal, block_q, block_k, interpret)
+        return _fwd_4d(q, k, v, scale, causal, block_q, block_k, interpret, impl)
 
     def infer(mesh, arg_shapes, shape):
         b, h = _batch_head_axes(mesh, arg_shapes)
@@ -602,7 +208,7 @@ def _partitioned_fwd(scale, causal, block_q, block_k, interpret):
         lsh = NamedSharding(mesh, P(b, h, None))
 
         def lower(q, k, v):
-            return _fwd_4d(q, k, v, scale, causal, block_q, block_k, interpret)
+            return _fwd_4d(q, k, v, scale, causal, block_q, block_k, interpret, impl)
 
         # k/v share the head axis on their (smaller) group dim: GQA under tp
         # needs tp | KV, which every llama/mixtral plan in-tree satisfies
@@ -619,13 +225,13 @@ def _partitioned_fwd(scale, causal, block_q, block_k, interpret):
 
 
 @functools.lru_cache(maxsize=64)
-def _partitioned_bwd(scale, causal, block_q, block_k, interpret):
+def _partitioned_bwd(scale, causal, block_q, block_k, interpret, impl):
     from jax.experimental.custom_partitioning import custom_partitioning
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     @custom_partitioning
     def bwd(q, k, v, o, do, lse):
-        return _bwd_4d(q, k, v, o, do, lse, scale, causal, block_q, block_k, interpret)
+        return _bwd_4d(q, k, v, o, do, lse, scale, causal, block_q, block_k, interpret, impl)
 
     def infer(mesh, arg_shapes, shape):
         b, h = _batch_head_axes(mesh, arg_shapes)
@@ -638,7 +244,7 @@ def _partitioned_bwd(scale, causal, block_q, block_k, interpret):
         lsh = NamedSharding(mesh, P(b, h, None))
 
         def lower(q, k, v, o, do, lse):
-            return _bwd_4d(q, k, v, o, do, lse, scale, causal, block_q, block_k, interpret)
+            return _bwd_4d(q, k, v, o, do, lse, scale, causal, block_q, block_k, interpret, impl)
 
         return mesh, lower, (qsh, qsh, qsh), (qsh, qsh, qsh, qsh, qsh, lsh)
 
@@ -655,20 +261,20 @@ def _partitioned_bwd(scale, causal, block_q, block_k, interpret):
     return bwd
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret, impl):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, impl)
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _partitioned_fwd(scale, causal, block_q, block_k, interpret)(q, k, v)
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, impl):
+    o, lse = _partitioned_fwd(scale, causal, block_q, block_k, interpret, impl)(q, k, v)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, impl, res, g):
     q, k, v, o, lse = res
-    return _partitioned_bwd(scale, causal, block_q, block_k, interpret)(q, k, v, o, g, lse)
+    return _partitioned_bwd(scale, causal, block_q, block_k, interpret, impl)(q, k, v, o, g, lse)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -688,18 +294,37 @@ def flash_attention(
     GQA/MQA run natively: the kernels route each q head to its kv group via
     BlockSpec index maps, so the repeated K/V heads are never materialized
     in HBM (vs the torch-reference pattern of repeat_kv before SDPA).
-    Divisibility: T % block sizes == 0 (pad upstream); off-TPU falls back to
-    the jnp reference."""
+    Divisibility: T % block sizes == 0 (pad upstream).
+
+    Dispatch: the Pallas kernel runs on TPU, under ``interpret=True``, or
+    under ``VESCALE_KERNELS=interpret`` (which resolves an unset
+    ``interpret`` to True — CPU tier-1 then exercises the kernel path);
+    anywhere else the jnp dense reference runs.  ``VESCALE_KERNELS=off``
+    reproduces the pre-kernel-layer dispatch byte-for-byte."""
     B, T, H, D = q.shape
     G = k.shape[2]
     if H % max(G, 1):
         raise ValueError(f"q heads {H} not a multiple of kv heads {G}")
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    from .. import kernels as _kernels
+
+    kmode = _kernels.mode()
     on_tpu = jax.devices()[0].platform == "tpu"
     if interpret is None:
-        interpret = False  # off-TPU default = dense fallback, NOT interpreter
+        # off-TPU default = dense fallback, NOT the interpreter — unless the
+        # kernel contract asks for the interpreter explicitly
+        interpret = kmode == "interpret"
+
+    def _xla_fallback():
+        if kmode == "off":
+            return _dense_ref(q, k, v, scale, causal)
+        # an enabled kernel mode takes the SHARED partition rule's xla leg
+        # (same custom_vjp, same GSPMD behavior as the kernel) and counts
+        _kernels.record_fallback("flash_attention")
+        return _flash(q, k, v, scale, causal, 0, 0, False, "xla")
+
     if not _HAS_PALLAS or (not on_tpu and not interpret):
-        return _dense_ref(q, k, v, scale, causal)
+        return _xla_fallback()
 
     def fit(block: int) -> int:
         # largest power-of-two block <= requested that divides T, so e.g.
@@ -712,8 +337,10 @@ def flash_attention(
 
     block_q, block_k = fit(block_q), fit(block_k)
     if T % block_q or T % block_k:
-        return _dense_ref(q, k, v, scale, causal)
-    return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
+        return _xla_fallback()
+    if kmode != "off":
+        _kernels.record_dispatch("flash_attention")
+    return _flash(q, k, v, scale, causal, block_q, block_k, interpret, "pallas")
 
 
 def flash_attention_sharded(
@@ -737,7 +364,8 @@ def flash_attention_sharded(
 
     ``q/k/v``: (B, T, H, D) with B shardable over ``batch_dims`` and H over
     ``head_dim``.  Seq-sharded inputs belong to ring/ulysses instead
-    (parallel/context.py)."""
+    (parallel/context.py).  Dispatch inside the shard_map body follows the
+    same ``VESCALE_KERNELS`` contract as :func:`flash_attention`."""
     from jax.sharding import PartitionSpec as P
 
     from ..collectives import shard_map
@@ -746,16 +374,26 @@ def flash_attention_sharded(
     hd = head_dim if head_dim in mesh.mesh_dim_names else None
     if not names and hd is None:
         return flash_attention(q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k, interpret=interpret)
+    from .. import kernels as _kernels
+
     D = q.shape[-1]
     scale_ = scale if scale is not None else 1.0 / math.sqrt(D)
-    fn = _sharded_flash_fn(mesh, names, hd, causal, float(scale_), block_q, block_k, bool(interpret) if interpret is not None else None)
+    # the kernel mode is part of the cache key: the body's dispatch is
+    # latched at trace time, so a mode flip must build (and compile) a
+    # fresh program instead of silently reusing the other path's
+    fn = _sharded_flash_fn(mesh, names, hd, causal, float(scale_), block_q, block_k,
+                           bool(interpret) if interpret is not None else None,
+                           _kernels.mode())
     return fn(q, k, v)
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_flash_fn(mesh, batch_names, head_name, causal, scale, block_q, block_k, interpret):
+def _sharded_flash_fn(mesh, batch_names, head_name, causal, scale, block_q, block_k,
+                      interpret, kmode):
     """Cached compiled program (jit cache is keyed on fn identity; a fresh
-    closure per call would recompile every step)."""
+    closure per call would recompile every step).  ``kmode`` is unused in
+    the body (the dispatch inside re-reads it at trace time) but keys the
+    cache so each VESCALE_KERNELS mode gets its own compilation."""
     from jax.sharding import PartitionSpec as P
 
     from ..collectives import shard_map

@@ -494,6 +494,11 @@ def scale_by_adam_lowmem(
     mantissa — the same trade the reference's bf16 mixed-precision training
     makes for params (legacy/examples/llama2_4D_finetune/llama_train.py dtype
     flags).  fp32 ``state_dtype`` reproduces optax.scale_by_adam exactly.
+
+    With ``VESCALE_KERNELS`` enabled the per-leaf elementwise chain runs as
+    ONE fused Pallas kernel (``kernels.fused_adamw``) — same ops, same
+    order, bit-identical under jit (asserted in tests/test_kernels.py);
+    the decision is latched per trace (docs/kernels.md).
     """
 
     def init(params):
@@ -509,7 +514,18 @@ def scale_by_adam_lowmem(
         c1 = 1.0 - b1 ** count.astype(jnp.float32)
         c2 = 1.0 - b2 ** count.astype(jnp.float32)
 
+        from .. import kernels as _kernels
+
+        interp = _kernels.resolve("fused_adamw")  # None -> the XLA chain
+
         def one(g, m, v):
+            if interp is not None and g.ndim > 0:
+                from ..kernels.fused_adamw import fused_adamw_update
+
+                return fused_adamw_update(
+                    g, m, v, c1, c2, b1=b1, b2=b2, eps=eps,
+                    state_dtype=state_dtype, interpret=interp,
+                )
             g32 = g.astype(jnp.float32)
             m32 = b1 * m.astype(jnp.float32) + (1.0 - b1) * g32
             v32 = b2 * v.astype(jnp.float32) + (1.0 - b2) * jnp.square(g32)

@@ -21,9 +21,17 @@ come and go:
 
   **decode** — one token per active slot: project q/k/v for the new
   position, scatter k/v into the page the slot's table maps that position
-  to, then paged attention (gather the slot's pages, mask by length,
-  online fp32 softmax).  Inactive slots compute too (static shapes) but
-  write only the reserved null page and their logits are ignored.
+  to, then paged attention.  With ``VESCALE_KERNELS`` off that is the XLA
+  chain (gather the slot's pages, mask by length, fp32 softmax, matmul);
+  with a kernel mode enabled it is ONE fused Pallas kernel per layer
+  (``kernels.paged_attention``) reading K/V straight from the page pool
+  through the scalar-prefetched page table — no dense (S, Tmax) gather
+  ever materializes, and a kv-head-sharded cache runs the kernel
+  per-shard inside the existing shard_map shim (zero communication, same
+  collective count as the XLA path).  The mode is latched when the engine
+  is BUILT (compiled programs are static); rebuild to switch.  Inactive
+  slots compute too (static shapes) but write only the reserved null page
+  and their logits are ignored.
 
 Decode is a deterministic function of (params, prompt, cache geometry):
 an evicted-and-replayed request regenerates bit-identical tokens in any
@@ -263,6 +271,53 @@ class ServeEngine:
             o = jnp.einsum("skgt,stkd->skgd", p, vs.astype(jnp.float32))
             return o.reshape(S, H * hd).astype(dtype)
 
+        # ---- kernel dispatch (latched at build: the decode program is
+        # compiled once; VESCALE_KERNELS is read here, not per step)
+        from .. import kernels as _kernels
+
+        kernel_interpret = _kernels.resolve("paged_decode")
+        # mesh axis sharding the pool's kv-head dim (dim 3 of the 5-D
+        # cache layout; dim 2 of the per-layer slice the kernel sees) —
+        # the kernel runs per-shard under the shard_map shim there
+        kernel_shard_ax = None
+        if kernel_interpret is not None:
+            for i, p in enumerate(cache.spec.placements):
+                if p.is_shard(3) and self.mesh.shape[i] > 1:
+                    kernel_shard_ax = self.mesh.mesh_dim_names[i]
+                    break
+
+        def paged_attention_kernel(q, kl, vl, table, valid_len):
+            from ..collectives import shard_map
+            from ..kernels.paged_attention import paged_decode
+
+            def body(q_l, kl_l, vl_l, table_l, len_l):
+                return paged_decode(
+                    q_l, kl_l, vl_l, table_l, len_l,
+                    scale=scale, interpret=kernel_interpret,
+                )
+
+            if kernel_shard_ax is None:
+                out = body(q, kl, vl, table, valid_len)
+            else:
+                ax = kernel_shard_ax
+                out = shard_map(
+                    body,
+                    mesh=self.mesh.jax_mesh,
+                    in_specs=(
+                        P(None, ax, None),
+                        P(None, None, ax, None),
+                        P(None, None, ax, None),
+                        P(),
+                        P(),
+                    ),
+                    out_specs=P(None, ax, None),
+                    check_vma=False,
+                    axis_names=frozenset({ax}),
+                )(q, kl, vl, table, valid_len)
+            return out.reshape(S, H * hd).astype(dtype)
+
+        attend = paged_attention if kernel_interpret is None else paged_attention_kernel
+
         def decode(params, kd, vd, table, lengths, tokens):
             x = embed(params, tokens)  # (S, E)
             pos = lengths  # write position of the new token
@@ -278,7 +333,7 @@ class ServeEngine:
                 k1, v1 = k[:, 0], v[:, 0]
                 kd = kd.at[l, pg, off].set(k1.astype(kd.dtype))
                 vd = vd.at[l, pg, off].set(v1.astype(vd.dtype))
-                y = paged_attention(q[:, 0], kd[l], vd[l], table, pos + 1)
+                y = attend(q[:, 0], kd[l], vd[l], table, pos + 1)
                 x = x + dense(y, lp["self_attn"]["o_proj"]["kernel"])
                 xn2 = _rmsnorm(x, lp["post_attention_layernorm"]["weight"], eps).astype(dtype)
                 gt = dense(xn2, lp["mlp"]["gate_proj"]["kernel"])

@@ -160,6 +160,11 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     # serving block: the continuous-batching loop's request ledger +
     # in-flight/queue gauges (serve/scheduler.py + serve/loop.py feed)
     _sv = ("serve_",)
+    # pallas kernel layer: dispatch/fallback decision totals per kernel
+    # (kernels/__init__.py feed, riding the same registry gate)
+    _kn = ("kernel_",)
+    kn_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_kn)}
+    kn_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_kn)}
     res_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_res)}
     qc_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_qc)}
     tr_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_tr)}
@@ -168,7 +173,7 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     other_gauges = {
         n: v
         for n, v in snap["gauges"].items()
-        if not n.startswith(("mem_",) + _res + _qc + _tr + _cp + _sv)
+        if not n.startswith(("mem_",) + _res + _qc + _tr + _cp + _sv + _kn)
     }
     res_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_res)}
     qc_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_qc)}
@@ -178,7 +183,7 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     other_counters = {
         n: v
         for n, v in snap["counters"].items()
-        if not n.startswith(_res + _qc + _tr + _cp + _sv)
+        if not n.startswith(_res + _qc + _tr + _cp + _sv + _kn)
     }
     if other_counters:
         lines.append("counters:")
@@ -212,6 +217,15 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
             lines.append(f"  {name:<48} {_fmt(cp_counters[name]):>12}")
         for name in sorted(cp_gauges):
             lines.append(f"  {name:<48} {cp_gauges[name]:>12.6g}")
+    if kn_counters or kn_gauges:
+        # pallas kernel layer: dispatch-decision and fallback totals per
+        # kernel (decisions are host-side — once per eager call, once per
+        # trace for compiled programs; docs/kernels.md)
+        lines.append("kernels:")
+        for name in sorted(kn_counters):
+            lines.append(f"  {name:<48} {_fmt(kn_counters[name]):>12}")
+        for name in sorted(kn_gauges):
+            lines.append(f"  {name:<48} {kn_gauges[name]:>12.6g}")
     if sv_counters or sv_gauges:
         # request ledger of the serve loop: admitted/completed/shed/
         # timed-out/evicted totals + in-flight and queue-depth gauges
