@@ -815,10 +815,25 @@ def bench_serve():
     run_once(engine, cache, arrivals, coordinate=False)  # compile warmup
     res, sched, wall, bare_iters = run_once(engine, cache, arrivals, coordinate=False)
     gen_tokens = sum(len(o["tokens"]) for o in res.outcomes.values())
+    # goodput vs raw: only COMPLETED requests' tokens are goodput — the
+    # gap is work burned on shed/evicted/timed-out requests (ISSUE 12)
+    goodput_tokens = sum(
+        len(o["tokens"]) for o in res.outcomes.values() if o["status"] == "completed"
+    )
     ttft_p50 = sched._ttft.percentile(0.5)
     ttft_p99 = sched._ttft.percentile(0.99)
+    itl_p50 = sched._itl.percentile(0.5)
+    itl_p99 = sched._itl.percentile(0.99)
     shed_rate = sched.counts["shed"] / max(1, sched.counts["submitted"])
     step_real = _median(bare_iters)
+    # serve MFU: compiled decode program FLOPs over the measured step
+    from vescale_tpu.telemetry.calibrate import device_peak_flops
+
+    decode_flops = engine.decode_flops_per_step()
+    serve_mfu = (
+        round(decode_flops / step_real / device_peak_flops(devices[0]), 6)
+        if decode_flops and step_real > 0 else None
+    )
 
     # -------------------------------------- quiescent envelope overhead
     # the watchdog-rung method: a NOP engine isolates the loop's per-step
@@ -876,6 +891,31 @@ def bench_serve():
         wd.stop()
     assert wd.fired == 0, "watchdog fired during a quiescent serve bench"
     overhead = max(0.0, armed - plain)
+
+    # -------------------- request tracing + ops endpoints overhead
+    # the ISSUE-12 acceptance bar: the SAME nop load with per-request
+    # lifecycle spans recording (live ndtimeline) AND the ops HTTP thread
+    # up, vs the plain loop above — per-iteration delta as a fraction of a
+    # real decode step must stay under the <1% envelope bar
+    from vescale_tpu.ndtimeline import api as nd_api
+
+    from vescale_tpu.analysis import envreg
+
+    old_mgr, old_active = nd_api._MANAGER, nd_api._ACTIVE
+    old_ops_port = envreg.get_raw("VESCALE_SERVE_OPS_PORT")
+    os.environ["VESCALE_SERVE_OPS_PORT"] = "0"
+    try:
+        nd_api.init_ndtimers(rank=0, max_spans=200_000)
+        traced = nop_median(coordinate=False)
+        nd_api.get_manager().flush()  # drop the spans between runs
+        traced = min(traced, nop_median(coordinate=False))
+    finally:
+        if old_ops_port is None:
+            os.environ.pop("VESCALE_SERVE_OPS_PORT", None)
+        else:
+            os.environ["VESCALE_SERVE_OPS_PORT"] = old_ops_port
+        nd_api._MANAGER, nd_api._ACTIVE = old_mgr, old_active
+    obs_overhead = max(0.0, traced - plain)
     print(json.dumps({
         "metric": "serve_tokens_per_s" if on_tpu else "serve_tokens_per_s_cpu",
         "value": round(gen_tokens / wall, 2),
@@ -887,8 +927,15 @@ def bench_serve():
         "ttft_p99_ms": round(ttft_p99 * 1e3, 3) if ttft_p99 else None,
         "decode_steps": res.steps,
         "decode_step_ms": round(step_real * 1e3, 3),
+        "goodput_tokens_per_s": round(goodput_tokens / wall, 2),
+        "goodput_fraction": round(goodput_tokens / max(1, gen_tokens), 4),
+        "itl_p50_ms": round(itl_p50 * 1e3, 3) if itl_p50 else None,
+        "itl_p99_ms": round(itl_p99 * 1e3, 3) if itl_p99 else None,
+        "serve_mfu": serve_mfu,
         "resilience_overhead_frac": round(overhead / step_real, 5) if step_real > 0 else None,
         "resilience_overhead_us_per_step": round(overhead * 1e6, 2),
+        "obs_overhead_frac": round(obs_overhead / step_real, 5) if step_real > 0 else None,
+        "obs_overhead_us_per_step": round(obs_overhead * 1e6, 2),
         "nop_iters": nul_iters,
         "acceptance_lt": 0.01,
     }))

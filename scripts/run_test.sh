@@ -18,6 +18,8 @@ echo "=== pallas kernel smoke (off byte-identity, interpret parity, collective-c
 python scripts/kernels_smoke.py || failed=1
 echo "=== resilient serving smoke (train@2 -> serve@1 bit-identical, coordinated faults, drain)"
 python scripts/serve_smoke.py || failed=1
+echo "=== serve observability smoke (request span chains ledger-matched, live ops endpoints)"
+python scripts/serve_obs_smoke.py || failed=1
 for f in tests/test_*.py; do
   echo "=== $f"
   python -m pytest "$f" -q || failed=1

@@ -1,0 +1,340 @@
+"""Serve-observability smoke — the acceptance run of ISSUE 12.
+
+One 2-process gloo serve world runs the PR-10 fault battery (one-sided
+oom / request_timeout / preempt injections, OR-agreed over the control
+plane) with the FULL observability layer armed:
+
+  * per-request lifecycle tracing (ndtimeline live: submit -> queue-wait
+    -> prefill -> decode-token* -> terminal span chains, evictions
+    forking), per-rank span streams dumped to disk;
+  * telemetry with a JSONL stream — the serve decode loop advances the
+    profiler step counter itself, so every steps.jsonl serve line's
+    ``spans`` rollup attributes to its OWN decode step (asserted);
+  * live ops endpoints (``VESCALE_SERVE_OPS_PORT=0``): a concurrent
+    poller thread hammers ``/healthz`` + ``/router`` + ``/metrics``
+    throughout the run while the step callback reads ``/healthz``
+    synchronously every boundary — the drain must be VISIBLE live
+    (``draining: true`` mid-preemption), ``/metrics`` must stay parseable,
+    ``/router`` must carry exactly the frozen schema.
+
+After both ranks exit, the driver merges the two span streams with the
+PR-9 clock offsets into one Perfetto trace, loads it BACK, and asserts
+the taxonomy<->ledger lockstep per rank over the round-tripped spans:
+every request in the (byte-identical) scheduler ledgers has a complete,
+ledger-matched span chain — and no orphan chains.  Flow events (the
+submit->terminal arrows) and per-slot lanes must survive in the written
+trace.
+
+Exit 0 on success.  Wired into scripts/run_test.sh and tier-1 via
+tests/test_serve_obs.py.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SERVE_FAULTS = "oom:step=4,rank=0;request_timeout:step=5,rank=1;preempt:step=7,rank=0"
+
+
+def _model_cfg():
+    import jax.numpy as jnp
+
+    from vescale_tpu.models.llama import LlamaConfig
+
+    return LlamaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=8,
+        max_position_embeddings=64,
+        dtype=jnp.float32,
+    )
+
+
+def _arrivals(Request, n=6):
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    out = []
+    for i in range(n):
+        prompt = tuple(int(x) for x in rng.integers(1, 120, 3 + (i % 3)))
+        out.append((2 * i, Request(
+            rid=i, prompt=prompt, max_new_tokens=4 + (i % 2), deadline_steps=40,
+        )))
+    return out
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+# --------------------------------------------------------------------- child
+def child(root: str, world: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import vescale_tpu.distributed as vdist
+
+    if world > 1:
+        vdist.initialize()
+    me = jax.process_index()
+    assert jax.process_count() == world
+
+    import jax.numpy as jnp  # noqa: E402
+
+    from vescale_tpu import telemetry  # noqa: E402
+    from vescale_tpu.mesh import DeviceMesh  # noqa: E402
+    from vescale_tpu.models.llama import Llama  # noqa: E402
+    from vescale_tpu.ndtimeline import api as nd_api  # noqa: E402
+    from vescale_tpu.ndtimeline.handlers import LocalRawHandler  # noqa: E402
+    from vescale_tpu.serve import (  # noqa: E402
+        ContinuousBatchingScheduler,
+        KVCacheConfig,
+        PagedKVCache,
+        Request,
+        ServeEngine,
+        reqtrace,
+        run_serve_resilient,
+    )
+    from vescale_tpu.serve.obs import ROUTER_FIELDS  # noqa: E402
+    from vescale_tpu.telemetry import ops_server  # noqa: E402
+    from vescale_tpu.telemetry.exporters import parse_prometheus_text  # noqa: E402
+    from vescale_tpu.telemetry.trace import estimate_clock_offsets  # noqa: E402
+
+    cfg = _model_cfg()
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))["params"]
+
+    ndev = len(jax.devices())
+    mesh = DeviceMesh(("tp",), (ndev,))
+    kc = KVCacheConfig(
+        layers=cfg.num_hidden_layers, kv_heads=cfg.num_key_value_heads,
+        head_dim=cfg.head_dim, num_slots=2, page_size=4, pages_per_slot=4,
+    )
+    cache = PagedKVCache(kc, mesh)  # tp-sharded kv heads
+    eng = ServeEngine(cfg, mesh, params, cache)
+    sched = ContinuousBatchingScheduler(cache, max_queue=8)
+
+    mgr = nd_api.init_ndtimers(rank=me)
+    telemetry.init(out_dir=os.path.join(root, f"tel_rank{me}"), rank=me,
+                   memtrack=False)
+
+    # ---- concurrent endpoint poller + synchronous per-step health reads
+    polled = {"healthz": [], "router": [], "metrics": []}
+    sync_health = []
+    stop_poll = threading.Event()
+
+    def poller():
+        while not stop_poll.is_set():
+            srv = ops_server.active_server()
+            if srv is not None:
+                for ep in ("healthz", "router", "metrics"):
+                    try:
+                        polled[ep].append(_get(f"{srv.url}/{ep}", timeout=2.0))
+                    except Exception as e:  # server may be stopping
+                        if not stop_poll.is_set():
+                            raise AssertionError(f"poll {ep} failed: {e}") from e
+            time.sleep(0.001)
+
+    def on_step(step, active):
+        srv = ops_server.active_server()
+        if srv is not None:
+            sync_health.append(json.loads(_get(f"{srv.url}/healthz")[1]))
+
+    poll_thread = threading.Thread(target=poller, daemon=True)
+    poll_thread.start()
+    try:
+        res = run_serve_resilient(
+            engine=eng, scheduler=sched, arrivals=_arrivals(Request),
+            install_signal_handlers=False, coordinate=(world > 1),
+            barrier_timeout_s=60.0, on_step=on_step,
+        )
+    finally:
+        stop_poll.set()
+        poll_thread.join(timeout=5.0)
+    sched.ledger_check()
+    assert res.status == "preempted", res.status
+    assert ops_server.active_server() is None, "ops server leaked past the loop"
+
+    # ---- endpoints were live, truthful, and schema-stable mid-battery
+    assert sync_health, "no synchronous /healthz reads landed"
+    assert any(h["draining"] for h in sync_health), (
+        "drain never visible on /healthz during the preemption battery"
+    )
+    assert any(not h["draining"] for h in sync_health)
+    for ep in ("healthz", "router"):
+        assert polled[ep], f"concurrent poller never reached /{ep}"
+        for status, body in polled[ep]:
+            assert status == 200, (ep, status, body)
+            json.loads(body)
+    assert polled["metrics"]
+    for status, body in polled["metrics"]:
+        assert status == 200
+        series = parse_prometheus_text(body)
+        assert any(k.startswith("serve_") for k in series), "no serve_* series"
+    router_last = json.loads(polled["router"][-1][1])
+    assert set(router_last) == set(ROUTER_FIELDS), (
+        f"/router schema drifted: {sorted(set(router_last) ^ set(ROUTER_FIELDS))}"
+    )
+
+    # ---- steps.jsonl: serve lines attribute spans to their OWN step
+    jsonl = os.path.join(root, f"tel_rank{me}", "steps.jsonl")
+    serve_lines = [
+        json.loads(line) for line in open(jsonl)
+        if '"kind": "serve"' in line
+    ]
+    assert serve_lines, "no serve step lines in steps.jsonl"
+    steps_seen = [line["step"] for line in serve_lines]
+    assert steps_seen == sorted(set(steps_seen)), (
+        f"serve step lines not one-per-step: {steps_seen}"
+    )
+    for line in serve_lines:
+        spans = line.get("spans") or {}
+        assert spans.get("serve-decode-step", {}).get("count") == 1, (
+            f"decode span rollup misattributed at step {line['step']}: {spans}"
+        )
+
+    # ---- clock offsets (control plane) + span + ledger dumps
+    clock = estimate_clock_offsets()
+    if me == 0:
+        with open(os.path.join(root, "clock.json"), "w") as f:
+            json.dump(clock.as_dict(), f)
+        print(f"CLOCK_RESIDUAL_US={clock.residual_us:.1f}")
+    spans = mgr.flush()
+    problems = reqtrace.verify_request_chains(spans, res.outcomes)
+    assert not problems, f"rank {me} chain problems: {problems}"
+    LocalRawHandler(os.path.join(root, f"spans_rank{me}.jsonl"))(spans)
+    ledger = {
+        str(rid): {"status": o["status"], "tokens": o["tokens"],
+                   "replays": o.get("replays", 0)}
+        for rid, o in sorted(res.outcomes.items())
+    }
+    with open(os.path.join(root, f"ledger_rank{me}.json"), "w") as f:
+        json.dump({"status": res.status, "outcomes": ledger}, f, sort_keys=True)
+    telemetry.shutdown()
+    print(f"POLLED healthz={len(polled['healthz'])} router={len(polled['router'])} "
+          f"metrics={len(polled['metrics'])} sync={len(sync_health)}")
+    print(f"OK proc {me}")
+
+
+# -------------------------------------------------------------------- driver
+def _load_spans(path):
+    from vescale_tpu.ndtimeline.timer import Span
+
+    out = []
+    for line in open(path):
+        d = json.loads(line)
+        out.append(Span(metric=d["metric"], start=d["start"],
+                        duration=d["duration"], step=d["step"],
+                        rank=d["rank"], tags=d["tags"]))
+    return out
+
+
+def main() -> None:
+    sys.path.insert(0, REPO)
+    from vescale_tpu.testing import make_child_env, run_gloo_world
+
+    work = tempfile.mkdtemp(prefix="serve_obs_smoke_")
+    try:
+        t0 = time.monotonic()
+
+        def spawn(port):
+            procs = []
+            for pid in range(2):
+                env = make_child_env(
+                    port, pid, 2,
+                    scrub=("VESCALE_FAULTSIM", "VESCALE_KERNELS",
+                           "VESCALE_SERVE_OPS_PORT"),
+                    extra={"VESCALE_FAULTSIM": SERVE_FAULTS,
+                           "VESCALE_SERVE_OPS_PORT": "0"},
+                )
+                procs.append(subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__), "--child", work, "2"],
+                    env=env, cwd=REPO, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True,
+                ))
+            return procs
+
+        results = run_gloo_world(spawn, timeout=420)
+        for pid, (rc, out) in enumerate(results):
+            assert rc == 0, f"proc {pid} rc={rc}\n{out[-5000:]}"
+            assert f"OK proc {pid}" in out, f"proc {pid}\n{out[-2000:]}"
+
+        # ---- coordinated ledgers byte-identical
+        ledgers = [open(os.path.join(work, f"ledger_rank{r}.json")).read()
+                   for r in (0, 1)]
+        assert ledgers[0] == ledgers[1], (
+            "coordinated ledgers diverged:\n" + ledgers[0] + "\n" + ledgers[1]
+        )
+        led = json.loads(ledgers[0])
+        assert led["status"] == "preempted", led
+        statuses = {rid: o["status"] for rid, o in led["outcomes"].items()}
+        assert any(o["replays"] for o in led["outcomes"].values()), (
+            "fault battery produced no eviction/replay fork"
+        )
+
+        # ---- merge the two rank streams -> ONE Perfetto timeline
+        from vescale_tpu.serve.reqtrace import verify_request_chains
+        from vescale_tpu.telemetry.trace import (
+            ClockSync,
+            merge_traces,
+            load_perfetto,
+            spans_from_perfetto,
+            write_perfetto,
+        )
+
+        clock = ClockSync.from_dict(json.load(open(os.path.join(work, "clock.json"))))
+        streams = {r: _load_spans(os.path.join(work, f"spans_rank{r}.jsonl"))
+                   for r in (0, 1)}
+        merged = merge_traces(streams, clock=clock)
+        assert {s.rank for s in merged} == {0, 1}
+        trace_path = os.path.join(work, "serve_trace.json")
+        write_perfetto(merged, trace_path)
+
+        # ---- the lockstep proof runs over the ROUND-TRIPPED trace: every
+        # ledger outcome has a complete chain on EVERY rank, no orphans
+        reloaded = spans_from_perfetto(trace_path)
+        outcomes = {int(rid): o for rid, o in led["outcomes"].items()}
+        for rank in (0, 1):
+            rank_spans = [s for s in reloaded if s.rank == rank]
+            problems = verify_request_chains(rank_spans, outcomes)
+            assert not problems, f"rank {rank} merged-trace chains: {problems}"
+
+        # ---- flow arrows + per-slot lanes survived into the written JSON
+        events = load_perfetto(trace_path)["traceEvents"]
+        flow_ids = {e["id"] for e in events if e.get("ph") in ("s", "f")}
+        assert flow_ids >= {f"req{rid}" for rid in outcomes}, (
+            f"missing submit->terminal flow arrows: {sorted(flow_ids)}"
+        )
+        lanes = {e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+        assert any(name.startswith("stage") for name in lanes), lanes
+
+        print(
+            "SERVE OBS SMOKE OK: 2-rank fault-battery run -> merged Perfetto "
+            f"timeline with {len(merged)} spans, every ledger outcome "
+            f"({json.dumps(statuses, sort_keys=True)}) chain-complete on both "
+            "ranks, live /healthz saw the drain, /router schema frozen "
+            f"({time.monotonic() - t0:.1f}s)"
+        )
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[2], int(sys.argv[3]))
+    else:
+        main()

@@ -302,6 +302,8 @@ register("VESCALE_SERVE_SLO_TTFT_S", "float", 0.0,
          "p99 time-to-first-token SLO budget in seconds; while the rolling p99 exceeds it new submissions are shed (0 disables).")
 register("VESCALE_SERVE_DEADLINE_S", "float", 0.0,
          "Default per-request wall-clock deadline in seconds (timeout cancellation); 0 disables (requests may still carry explicit deadlines).")
+register("VESCALE_SERVE_OPS_PORT", "int", None,
+         "Localhost port for the serve loop's live ops endpoints (`/metrics`, `/healthz`, `/router`): unset = endpoints off (no thread, no socket), 0 = auto-assign a free port (docs/serving.md).")
 
 # --- trace timeline / cost calibration -------------------------------
 register("VESCALE_COST_CALIBRATION", "str", None,

@@ -21,3 +21,16 @@ DATA_LOAD = "data-load"
 CHECKPOINT_SAVE = "checkpoint-save"
 CHECKPOINT_LOAD = "checkpoint-load"
 CHECKPOINT_COMMIT = "checkpoint-commit"
+# serve request lifecycle (serve/reqtrace.py emits; docs/observability.md
+# "Request-span taxonomy").  Every request's chain is
+#   submit -> [queue-wait -> prefill -> decode-token*]* -> terminal
+# with an evict span marking each replay fork; the terminal span's
+# ``outcome`` tag matches the scheduler ledger status exactly
+# (reqtrace.verify_request_chains asserts the lockstep).
+SERVE_SUBMIT = "serve-submit"
+SERVE_QUEUE_WAIT = "serve-queue-wait"
+SERVE_PREFILL = "serve-prefill"
+SERVE_DECODE_STEP = "serve-decode-step"
+SERVE_DECODE_TOKEN = "serve-decode-token"
+SERVE_EVICT = "serve-evict"
+SERVE_TERMINAL = "serve-terminal"

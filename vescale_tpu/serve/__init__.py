@@ -19,9 +19,11 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
+from . import obs, reqtrace
 from .engine import ServeEngine
 from .kv_cache import KVCacheConfig, KVCacheOutOfPages, PagedKVCache
 from .loop import ServeResult, run_serve_resilient
+from .obs import ServeObservability
 from .scheduler import ContinuousBatchingScheduler, Request, ShedError
 
 __all__ = [
@@ -33,8 +35,11 @@ __all__ = [
     "ShedError",
     "ServeEngine",
     "ServeResult",
+    "ServeObservability",
     "run_serve_resilient",
     "load_params",
+    "obs",
+    "reqtrace",
 ]
 
 

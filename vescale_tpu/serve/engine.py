@@ -51,6 +51,8 @@ from .kv_cache import PagedKVCache
 
 __all__ = ["ServeEngine", "stack_params_check"]
 
+_UNSET = object()  # decode_flops_per_step's not-yet-computed sentinel
+
 
 def _rmsnorm(x, w, eps):
     import jax
@@ -120,6 +122,7 @@ class ServeEngine:
         self.params = jax.tree_util.tree_map(self._replicate, params)
         self.stage_bounds = self._stage_bounds(num_stages)
         self._positions = np.arange(cache.max_seq_len, dtype=np.int32)[None, :]
+        self._decode_flops: Any = _UNSET
         self._build()
 
     # ------------------------------------------------------------- params
@@ -393,6 +396,35 @@ class ServeEngine:
         )
         cache.update(kd, vd)
         return np.asarray(logits)
+
+    def decode_flops_per_step(self) -> Optional[float]:
+        """XLA's FLOP count for ONE compiled decode step (all slots) — the
+        numerator of the serve MFU gauge (telemetry compile-report
+        convention: the COMPILED program's cost analysis, not an analytic
+        guess).  Lowered once from the live cache arrays (shardings ride
+        along; nothing executes) and cached; backends that cannot report
+        cost analysis return None and MFU stays unpublished."""
+        if self._decode_flops is not _UNSET:
+            return self._decode_flops
+        flops: Optional[float] = None
+        try:
+            from ..telemetry.step_report import _cost_dict
+
+            cache = self.cache
+            compiled = self._decode_fn.lower(
+                self.params,
+                cache.k.data,
+                cache.v.data,
+                cache.table_array(),
+                cache.lengths_array(),
+                np.zeros((cache.num_slots,), np.int32),
+            ).compile()
+            v = _cost_dict(compiled).get("flops")
+            flops = float(v) if v and v > 0 else None
+        except Exception:
+            flops = None
+        self._decode_flops = flops
+        return flops
 
     @staticmethod
     def greedy(logits_row: np.ndarray) -> int:

@@ -36,6 +36,14 @@ Accounting contract (asserted by scripts/serve_smoke.py under injected
 faults): every submitted request reaches EXACTLY one terminal outcome —
 ``completed`` (with deterministic tokens), ``shed``, ``timed_out`` or
 ``preempted_requeue`` — none lost, none duplicated.
+
+Observability (ISSUE 12; docs/serving.md): with the ndtimeline profiler
+live every request emits its lifecycle span chain (reqtrace.py) and each
+decode step advances the telemetry step counter + writes its own
+``kind="serve"`` steps.jsonl line; goodput/MFU gauges ride the registry
+(obs.py); ``VESCALE_SERVE_OPS_PORT`` starts the live
+``/metrics``+``/healthz``+``/router`` endpoints for probes and the
+multi-replica router.
 """
 
 from __future__ import annotations
@@ -49,7 +57,9 @@ from ..resilience import consistency as _cons
 from ..resilience import faultsim as _fs
 from ..resilience.preempt import PreemptionHandler
 from ..resilience.watchdog import Watchdog
+from . import reqtrace
 from .engine import ServeEngine
+from .obs import ServeObservability
 from .scheduler import ContinuousBatchingScheduler, Request
 
 __all__ = ["ServeResult", "run_serve_resilient"]
@@ -112,6 +122,8 @@ def run_serve_resilient(
 
     from .. import telemetry as _tel
     from ..analysis import envreg
+    from ..ndtimeline import api as _nd
+    from ..telemetry import ops_server as _ops
 
     if not _fs.is_armed():
         _fs.arm_from_env()
@@ -147,6 +159,21 @@ def run_serve_resilient(
     draining = False
     result = ServeResult(status="completed")
     cache = scheduler.cache
+
+    # ------------------------------------------- observability wiring
+    # goodput/MFU accounting + the /healthz + /router providers; the ops
+    # HTTP thread starts ONLY when VESCALE_SERVE_OPS_PORT is set (off by
+    # default — maybe_start returns None without creating a thread)
+    obs = ServeObservability(
+        scheduler, engine=engine, watchdog=wd, rank=jax.process_index()
+    )
+    ops = _ops.maybe_start(health=obs.health, router=obs.router)
+    # cold-start retry_after_s seed: with a calibration table armed the
+    # decode step is priceable before anything has run; the first prefill
+    # wall time (below) covers the un-calibrated case
+    cal_seed = obs.calibrated_step_estimate()
+    if cal_seed is not None:
+        scheduler.seed_step_time(cal_seed)
 
     def _event(kind: str, **fields) -> None:
         _tel.record_event(f"serve_{kind}", **fields)
@@ -201,20 +228,40 @@ def run_serve_resilient(
         for inf in admitted:
             _beat(step, "prefill")
             inf.admit_wall = time.perf_counter()
+            # queue-wait is measured to THIS request's own prefill start
+            # (not the admit() pop): with several same-batch admissions the
+            # later ones "wait" through the earlier prefills too, so the
+            # queue_wait + prefill components tile the TTFT exactly
+            wait_s = max(0.0, inf.admit_wall - inf.submit_wall)
+            reqtrace.queue_wait(inf.req.rid, inf.slot, wait_s, replays=inf.replays)
+            _tel.observe("serve_ttft_queue_wait_seconds", wait_s)
             logits = engine.prefill(inf.req.prompt, inf.slot)
             cache.commit_prefill(inf.slot, len(inf.req.prompt))
             tok = engine.greedy(logits)
             _sample(inf.slot, tok)
+            now = time.perf_counter()
+            prefill_s = now - inf.admit_wall
+            reqtrace.prefill(inf.req.rid, inf.slot, prefill_s)
+            # cold-start retry seed: the first prefill wall time is the
+            # first measured bound on a step of this model (conservative —
+            # a decode step is cheaper than a full prefill)
+            scheduler.seed_step_time(prefill_s)
             # TTFT anchors at SUBMISSION: under load the queue wait is the
-            # dominant term, and the SLO shed path must see it
-            ttft = time.perf_counter() - inf.submit_wall
+            # dominant term, and the SLO shed path must see it.  The
+            # queue-wait component was observed at admission (scheduler);
+            # this is the rest — the decomposition's prefill half
+            ttft = now - inf.submit_wall
             scheduler.observe_ttft(ttft)
+            _tel.observe("serve_ttft_prefill_seconds", prefill_s)
             _event("admit", rid=inf.req.rid, slot=inf.slot, at_step=step,
                    replays=inf.replays, ttft_s=round(ttft, 6))
 
     def _sample(slot: int, token: int) -> None:
         nonlocal token_crc
         scheduler.record_token(slot, token)
+        # EVERY sampled token is raw throughput — the prefill-sampled
+        # first token included, so raw >= goodput always holds
+        _tel.count("serve_tokens_generated_total")
         token_crc = zlib.crc32(int(token).to_bytes(4, "little", signed=False), token_crc)
 
     def _finish_done(step: int) -> None:
@@ -296,6 +343,7 @@ def run_serve_resilient(
             # ------------------------------------------------ drain / done
             if preempt_now and not draining:
                 draining = True
+                obs.draining = True  # /healthz reports the drain live
                 _tel.count("resilience_preemptions_total")
                 _event("drain_begin", at_step=step,
                        inflight=len(scheduler.active), queued=len(scheduler.queue))
@@ -338,13 +386,45 @@ def run_serve_resilient(
                     _sample(slot, engine.greedy(logits[slot]))
                 dt = time.perf_counter() - t0
                 scheduler.observe_step_time(dt)
+                # the batched step's wall time IS each active slot's
+                # inter-token latency: one ITL observation + one
+                # decode-token span (in the slot's lane) per sampled token
+                reqtrace.decode_step(step, dt, len(active_slots))
+                for slot in active_slots:
+                    inf = scheduler.active[slot]
+                    scheduler.observe_itl(dt)
+                    reqtrace.decode_token(
+                        inf.req.rid, slot, len(inf.tokens) - 1, dt
+                    )
                 _tel.count("serve_decode_steps_total")
+                obs.on_decode_step(step, dt, len(active_slots))
                 if draining:
                     before = scheduler.counts["completed"]
                     _finish_done(step)
                     result.drained += scheduler.counts["completed"] - before
                 else:
                     _finish_done(step)
+                # serve's auto_inc_step: every span this iteration emitted
+                # (prefill, decode, terminals) carries the CURRENT profiler
+                # step — advance the counter and record the per-step line
+                # NOW so the steps.jsonl spans rollup attributes them to
+                # this decode step, not a stale training step
+                if _nd.is_active():
+                    mgr = _nd.get_manager()
+                    span_step = mgr.step
+                    mgr.inc_step()
+                else:
+                    span_step = step
+                _tel.record_step(
+                    {
+                        "step": span_step,
+                        "serve_step": step,
+                        "step_time_s": dt,
+                        "active": len(active_slots),
+                        "queue_depth": len(scheduler.queue),
+                    },
+                    kind="serve",
+                )
             if on_step is not None:
                 on_step(step, len(scheduler.active))
             step += 1
@@ -352,6 +432,8 @@ def run_serve_resilient(
         result.steps = step
         result.outcomes = dict(scheduler.outcomes)
         result.counts = dict(scheduler.counts)
+        if ops is not None:
+            ops.stop()
         if own_wd:
             wd.stop()
         if own_handler and install_signal_handlers:

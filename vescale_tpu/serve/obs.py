@@ -1,0 +1,208 @@
+"""Serve-replica observability state — goodput/MFU accounting + the ops
+endpoint providers.
+
+One ``ServeObservability`` per ``run_serve_resilient`` call.  It owns the
+derived numbers the scheduler's raw ledger cannot answer alone:
+
+  * **goodput vs raw throughput** — ``serve_goodput_tokens_per_s`` counts
+    only tokens of COMPLETED requests (scheduler.goodput_tokens);
+    ``serve_throughput_tokens_per_s`` counts every sampled token.  The gap
+    IS the work wasted on evicted/timed-out/drained requests.
+  * **serve MFU** — the compiled decode program's XLA FLOP count
+    (``ServeEngine.decode_flops_per_step``, the compile-report convention)
+    over the measured step wall time, against
+    ``telemetry.calibrate.device_peak_flops`` — published per decode step
+    as the ``serve_mfu`` gauge.
+  * **the `/healthz` and `/router` payloads** — the callables
+    ``telemetry.ops_server.maybe_start`` binds to the endpoints.  The
+    `/router` schema is FROZEN at ``ROUTER_SCHEMA_VERSION`` (docs/
+    serving.md): the future multi-replica dispatcher polls it, so fields
+    are only ever added, never renamed or removed.
+
+Everything here is host-side floats; telemetry gauges are published only
+while the registry gate is up (``_tel.set_gauge`` no-ops when dormant),
+and the providers work with telemetry fully dormant — a liveness probe
+must not require a metrics pipeline.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+__all__ = ["ServeObservability", "ROUTER_SCHEMA_VERSION", "ROUTER_FIELDS"]
+
+ROUTER_SCHEMA_VERSION = 1
+# the frozen /router field set (schema v1) — tests assert the payload
+# carries exactly these keys, docs/serving.md documents their meaning
+ROUTER_FIELDS = frozenset(
+    (
+        "schema_version",
+        "rank",
+        "draining",
+        "queue_depth",
+        "inflight",
+        "slots",
+        "free_slots",
+        "pages",
+        "free_pages",
+        "ttft_s",
+        "itl_s",
+        "shed_rate",
+        "retry_after_s",
+        "goodput_tokens_per_s",
+        "throughput_tokens_per_s",
+        "mfu",
+        "decode_steps",
+        "serve_step",
+        "uptime_s",
+    )
+)
+
+
+def _pcts(hist) -> Dict[str, Optional[float]]:
+    return {
+        "p50": hist.percentile(0.5),
+        "p95": hist.percentile(0.95),
+        "p99": hist.percentile(0.99),
+    }
+
+
+class ServeObservability:
+    """Derived-rate bookkeeping + endpoint providers for one serve loop."""
+
+    def __init__(self, scheduler, engine=None, watchdog=None, rank: int = 0):
+        self.scheduler = scheduler
+        self.engine = engine
+        self.watchdog = watchdog
+        self.rank = int(rank)
+        self.draining = False  # the loop flips it; /healthz reports it
+        self.serve_step = 0
+        self.decode_steps = 0
+        self._start = time.perf_counter()
+        self._last_decode: Optional[float] = None
+        self._peak: Optional[float] = None
+        self._last_mfu: Optional[float] = None
+        # the MFU numerator needs a one-time AOT lower+compile of the
+        # decode program: pay it HERE, before the loop serves anything,
+        # rather than stalling the first telemetry-active decode step
+        # mid-batch (telemetry activated mid-run still resolves lazily)
+        from .. import telemetry as _tel
+
+        if _tel.is_active():
+            self._flops()
+
+    # ------------------------------------------------------------- rates
+    def _flops(self) -> Optional[float]:
+        if self.engine is None:
+            return None
+        fn = getattr(self.engine, "decode_flops_per_step", None)
+        return fn() if fn is not None else None
+
+    def _peak_flops(self) -> float:
+        if self._peak is None:
+            try:
+                import jax
+
+                from ..telemetry.calibrate import device_peak_flops
+
+                self._peak = device_peak_flops(jax.devices()[0])
+            except Exception:
+                self._peak = 1e12
+        return self._peak
+
+    def calibrated_step_estimate(self) -> Optional[float]:
+        """Decode-step seconds estimated from the compiled program's FLOPs
+        and the calibration table's measured ``matmul_gflops`` — the
+        scheduler's cold-start ``retry_after_s`` seed when a table is armed
+        (before even the first prefill has run)."""
+        from ..telemetry.calibrate import active_table
+
+        t = active_table()
+        g = t.meta.get("matmul_gflops") if t is not None else None
+        if not g:
+            return None  # checked FIRST: no table means no extra compile
+        flops = self._flops()
+        if not flops:
+            return None
+        return float(flops) / (float(g) * 1e9)
+
+    def on_decode_step(self, step: int, dt_s: float, active: int) -> None:
+        """Per decode step: advance the rate clocks and publish the
+        goodput/throughput/MFU gauges (no-ops while telemetry is dormant)."""
+        from .. import telemetry as _tel
+
+        self.decode_steps += 1
+        self.serve_step = int(step)
+        self._last_decode = time.perf_counter()
+        sched = self.scheduler
+        up = max(1e-9, self._last_decode - self._start)
+        goodput = sched.goodput_tokens / up
+        raw = sched.raw_tokens / up
+        if _tel.is_active():
+            _tel.set_gauge("serve_goodput_tokens_per_s", goodput)
+            _tel.set_gauge("serve_throughput_tokens_per_s", raw)
+            flops = self._flops()
+            if flops and dt_s > 0:
+                self._last_mfu = flops / dt_s / self._peak_flops()
+                _tel.set_gauge("serve_mfu", self._last_mfu)
+
+    # --------------------------------------------------------- providers
+    def health(self) -> Dict:
+        """`/healthz`: is this replica alive and making progress — the
+        watchdog's view (last-beat age), the decode loop's (last-step age),
+        and the capacity headroom a probe alerts on."""
+        sched = self.scheduler
+        cache = sched.cache
+        now = time.perf_counter()
+        wd = self.watchdog
+        return {
+            "ok": not self.draining,
+            "draining": self.draining,
+            "serve_step": self.serve_step,
+            "decode_steps": self.decode_steps,
+            "queue_depth": len(sched.queue),
+            "inflight": len(sched.active),
+            "free_slots": cache.free_slot_count(),
+            "free_pages": cache.free_page_count(),
+            "watchdog_last_beat_age_s": (
+                round(wd.stalled_s, 6) if wd is not None else None
+            ),
+            "last_decode_step_age_s": (
+                round(now - self._last_decode, 6)
+                if self._last_decode is not None
+                else None
+            ),
+            "uptime_s": round(now - self._start, 6),
+        }
+
+    def router(self) -> Dict:
+        """`/router`: the dispatch feed a multi-replica router polls —
+        FROZEN schema v1 (ROUTER_FIELDS; docs/serving.md)."""
+        sched = self.scheduler
+        cache = sched.cache
+        up = max(1e-9, time.perf_counter() - self._start)
+        submitted = max(1, sched.counts["submitted"])
+        out = {
+            "schema_version": ROUTER_SCHEMA_VERSION,
+            "rank": self.rank,
+            "draining": self.draining,
+            "queue_depth": len(sched.queue),
+            "inflight": len(sched.active),
+            "slots": cache.num_slots,
+            "free_slots": cache.free_slot_count(),
+            "pages": cache.num_pages - 1,  # page 0 is the reserved null page
+            "free_pages": cache.free_page_count(),
+            "ttft_s": _pcts(sched._ttft),
+            "itl_s": _pcts(sched._itl),
+            "shed_rate": sched.counts["shed"] / submitted,
+            "retry_after_s": sched.retry_after_s(),
+            "goodput_tokens_per_s": sched.goodput_tokens / up,
+            "throughput_tokens_per_s": sched.raw_tokens / up,
+            "mfu": self._last_mfu,
+            "decode_steps": self.decode_steps,
+            "serve_step": self.serve_step,
+            "uptime_s": round(up, 6),
+        }
+        assert set(out) == ROUTER_FIELDS  # the freeze, enforced at source
+        return out

@@ -39,6 +39,7 @@ import zlib
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
+from . import reqtrace
 from .kv_cache import PagedKVCache
 
 __all__ = ["Request", "ShedError", "ContinuousBatchingScheduler"]
@@ -125,6 +126,16 @@ class ContinuousBatchingScheduler:
         # dormant (the registry classes are plain objects, not the gate)
         self._ttft = Histogram("serve_ttft_seconds", window=ttft_window)
         self._step_time = Histogram("serve_decode_step_seconds", window=ttft_window)
+        self._itl = Histogram("serve_itl_seconds", window=ttft_window)
+        # cold-start seed for retry_after_s: before any decode step has
+        # been observed the 10ms floor wildly underestimates real models —
+        # the loop seeds this from the first prefill wall time (see
+        # seed_step_time) so the first shed wave's retry hint is honest
+        self._step_time_seed: Optional[float] = None
+        # goodput vs raw throughput (docs/serving.md): raw counts every
+        # sampled token; goodput only tokens of COMPLETED requests
+        self.raw_tokens = 0
+        self.goodput_tokens = 0
         self.counts = {
             "submitted": 0,
             "admitted": 0,
@@ -158,14 +169,34 @@ class ContinuousBatchingScheduler:
         self._step_time.observe(seconds)
         _tel.observe("serve_decode_step_seconds", seconds)
 
+    def observe_itl(self, seconds: float) -> None:
+        from .. import telemetry as _tel
+
+        self._itl.observe(seconds)
+        _tel.observe("serve_itl_seconds", seconds)
+
     def ttft_p99(self) -> Optional[float]:
         return self._ttft.percentile(0.99)
 
+    def seed_step_time(self, seconds: float) -> None:
+        """Seed the decode-step estimator before any real sample exists
+        (the loop passes the first PREFILL wall time — an overestimate of a
+        decode step, so the cold retry hint errs conservative instead of
+        telling shed clients to hammer a server that has never decoded).
+        Ignored once set or once real samples landed."""
+        if self._step_time_seed is None and self._step_time.count == 0:
+            self._step_time_seed = max(float(seconds), 1e-4)
+
     def retry_after_s(self) -> float:
         """Backpressure hint: how long until a shed client plausibly finds
-        room — queue depth x observed decode-step p50 (floor 10ms so an
-        unmeasured cold server still says *something* positive)."""
-        p50 = self._step_time.percentile(0.5) or 0.01
+        room — queue depth x observed decode-step p50.  Cold start (no
+        decode step observed yet) falls back to the seeded estimate
+        (seed_step_time: first prefill wall, or the loop's calibration-
+        derived guess), then a 10ms floor so an unmeasured server still
+        says *something* positive."""
+        p50 = self._step_time.percentile(0.5)
+        if p50 is None:
+            p50 = self._step_time_seed or 0.01
         return max(0.01, (len(self.queue) + 1) * max(p50, 1e-4))
 
     # ----------------------------------------------------------- admission
@@ -189,6 +220,7 @@ class ContinuousBatchingScheduler:
             self.counts["resubmitted"] += 1
             self._fold(17, req.rid, step)
         self.counts["submitted"] += 1
+        reqtrace.submit(req.rid, step)
         reason = None
         if len(self.queue) >= self.max_queue:
             reason = f"queue full ({len(self.queue)}/{self.max_queue})"
@@ -221,6 +253,7 @@ class ContinuousBatchingScheduler:
             _tel.count("serve_requests_shed_total")
             _tel.count("resilience_shed_total")
             _tel.record_event("serve_shed", rid=req.rid, reason=reason, retry_after_s=retry)
+            reqtrace.terminal(req.rid, "shed", 0, reason=reason)
             self._fold(10, req.rid, step)
             if raise_on_shed:
                 raise ShedError(req.rid, reason, retry)
@@ -272,6 +305,7 @@ class ContinuousBatchingScheduler:
 
     def record_token(self, slot: int, token: int) -> None:
         self.active[slot].tokens.append(int(token))
+        self.raw_tokens += 1
 
     def complete(self, slot: int) -> Dict[str, Any]:
         """EOS / token budget reached: the request is done."""
@@ -280,9 +314,13 @@ class ContinuousBatchingScheduler:
         inf = self.active.pop(slot)
         self.cache.free(slot)
         self.counts["completed"] += 1
+        # goodput: only tokens that reached a COMPLETED terminal count
+        self.goodput_tokens += len(inf.tokens)
         self._fold(13, inf.req.rid, slot, len(inf.tokens))
         self._terminal(inf, "completed")
+        reqtrace.terminal(inf.req.rid, "completed", len(inf.tokens), slot=slot)
         _tel.count("serve_requests_completed_total")
+        _tel.count("serve_goodput_tokens_total", len(inf.tokens))
         _tel.set_gauge("serve_inflight", len(self.active))
         return self.outcomes[inf.req.rid]
 
@@ -296,6 +334,8 @@ class ContinuousBatchingScheduler:
         self.counts["timed_out"] += 1
         self._fold(14, inf.req.rid, slot)
         self._terminal(inf, "timed_out", reason=reason)
+        reqtrace.terminal(inf.req.rid, "timed_out", len(inf.tokens),
+                          reason=reason, slot=slot)
         _tel.count("serve_requests_timed_out_total")
         _tel.record_event("serve_timeout", rid=inf.req.rid, slot=slot, reason=reason)
         _tel.set_gauge("serve_inflight", len(self.active))
@@ -316,9 +356,11 @@ class ContinuousBatchingScheduler:
                 self.outcomes[req.rid] = {
                     "status": "timed_out",
                     "tokens": [],
-                    "replays": 0,
+                    "replays": self._queued_replays(req.rid),
                     "reason": "queued past deadline",
                 }
+                reqtrace.terminal(req.rid, "timed_out", 0,
+                                  reason="queued past deadline")
                 _tel.count("serve_requests_timed_out_total")
                 _tel.record_event("serve_timeout", rid=req.rid,
                                   reason="queued past deadline")
@@ -329,6 +371,16 @@ class ContinuousBatchingScheduler:
         if expired:
             _tel.set_gauge("serve_queue_depth", len(self.queue))
         return expired
+
+    def _queued_replays(self, rid: int) -> int:
+        """How many times a still-QUEUED rid has already been evicted and
+        requeued — its ``evicted_replay`` transient marker records the
+        pre-eviction count (the ledger and the span chain's evict-span
+        count must agree even when the replay never gets readmitted)."""
+        prev = self.outcomes.get(rid)
+        if prev is not None and prev.get("status") == "evicted_replay":
+            return int(prev.get("replays", 0)) + 1
+        return 0
 
     def requeue_newest(self, reason: str = "oom") -> Optional[int]:
         """Evict the NEWEST admitted request and replay it from the queue
@@ -357,6 +409,8 @@ class ContinuousBatchingScheduler:
         # the ORIGINAL submit stamps ride along: the replayed request's
         # TTFT honestly includes everything since the client submitted
         self.queue.appendleft((inf.req, inf.submit_step, inf.submit_wall))
+        # the fork marker: this rid's chain re-runs queue-wait -> prefill
+        reqtrace.evict(inf.req.rid, slot, reason, replays=inf.replays + 1)
         _tel.count("serve_requests_evicted_total")
         _tel.record_event("serve_evict", rid=inf.req.rid, slot=slot, reason=reason)
         _tel.set_gauge("serve_inflight", len(self.active))
@@ -375,10 +429,11 @@ class ContinuousBatchingScheduler:
             self.outcomes[req.rid] = {
                 "status": "preempted_requeue",
                 "tokens": [],
-                "replays": 0,
+                "replays": self._queued_replays(req.rid),
                 "reason": reason,
                 "retry_after_s": self.retry_after_s(),
             }
+            reqtrace.terminal(req.rid, "preempted_requeue", 0, reason=reason)
             self.counts["shed"] += 1
             _tel.count("serve_requests_shed_total")
             _tel.count("resilience_shed_total")

@@ -28,7 +28,7 @@ Gating contract (same as ndtimeline): a run that never calls
 no tag registry (the memtrack hooks are no-op function references).
 """
 
-from . import calibrate, memtrack, trace
+from . import calibrate, memtrack, ops_server, trace
 from .api import (
     count,
     dashboard,
@@ -78,6 +78,7 @@ __all__ = [
     "memtrack",
     "trace",
     "calibrate",
+    "ops_server",
     "flight_recorder",
     "dump_now",
     "tagged",

@@ -132,34 +132,42 @@ def get_registry() -> Optional[MetricsRegistry]:
 # Each is a one-branch no-op while dormant: the runtime wiring calls these
 # unconditionally and un-instrumented runs must not allocate or lock.
 
-def record_step(metrics: Dict[str, Any]) -> None:
-    """Ingest one training step's metrics (the train.py feed).
+def record_step(metrics: Dict[str, Any], kind: str = "train") -> None:
+    """Ingest one step's metrics (the train.py feed; the serve loop's
+    decode steps pass ``kind="serve"``).
 
-    Conventions: ``step_time_s`` feeds the step-time histogram,
+    Train conventions: ``step_time_s`` feeds the step-time histogram,
     ``tokens`` the throughput counters, scalar floats become gauges.  The
-    full record (plus ``step``/``rank``/``ts``) appends to steps.jsonl."""
+    full record (plus ``step``/``rank``/``ts``) appends to steps.jsonl.
+
+    ``kind="serve"`` skips the train_* registry conventions (the serve
+    loop feeds its own ``serve_*`` metrics directly) but keeps everything
+    structural: the step counter, the memory sample and the per-step
+    ``spans`` rollup — so a decode step's spans land on a steps.jsonl line
+    of their OWN step instead of smearing onto a stale training step."""
     st = _STATE
     if st is None:
         return
     st.step = int(metrics.get("step", st.step + 1))
     reg = st.registry
-    reg.counter("train_steps_total").inc()
-    if "step_time_s" in metrics:
-        reg.histogram("train_step_time_seconds").observe(metrics["step_time_s"])
-    if "tokens" in metrics:
-        reg.counter("train_tokens_total").inc(metrics["tokens"])
-    if "tokens_per_sec" in metrics:
-        reg.gauge("train_tokens_per_sec").set(metrics["tokens_per_sec"])
-    for key, gname in (
-        ("loss", "train_loss"),
-        ("grad_norm", "train_grad_norm"),
-        ("loss_scale", "train_loss_scale"),
-        ("skip_count", "train_skipped_steps"),
-    ):
-        if key in metrics and metrics[key] is not None:
-            reg.gauge(gname).set(float(metrics[key]))
-    if metrics.get("overflow"):
-        reg.counter("train_overflow_steps_total").inc()
+    if kind == "train":
+        reg.counter("train_steps_total").inc()
+        if "step_time_s" in metrics:
+            reg.histogram("train_step_time_seconds").observe(metrics["step_time_s"])
+        if "tokens" in metrics:
+            reg.counter("train_tokens_total").inc(metrics["tokens"])
+        if "tokens_per_sec" in metrics:
+            reg.gauge("train_tokens_per_sec").set(metrics["tokens_per_sec"])
+        for key, gname in (
+            ("loss", "train_loss"),
+            ("grad_norm", "train_grad_norm"),
+            ("loss_scale", "train_loss_scale"),
+            ("skip_count", "train_skipped_steps"),
+        ):
+            if key in metrics and metrics[key] is not None:
+                reg.gauge(gname).set(float(metrics[key]))
+        if metrics.get("overflow"):
+            reg.counter("train_overflow_steps_total").inc()
     mem = None
     if st.memtrack is not None:
         # per-step memory sample: device gauges, tagged census, leak check
@@ -167,6 +175,8 @@ def record_step(metrics: Dict[str, Any]) -> None:
         mem = st.memtrack.on_step(st.step, reg)
     if st.jsonl is not None:
         rec = {"step": st.step, "rank": st.rank, "ts": time.time(), **metrics}
+        if kind != "train":
+            rec["kind"] = kind
         if mem is not None:
             rec["memory"] = mem
         spans = _step_spans()

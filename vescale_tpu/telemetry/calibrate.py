@@ -61,6 +61,7 @@ __all__ = [
     "compute_cost_us",
     "active_digest",
     "hop_latency_us",
+    "device_peak_flops",
     "clear_warned",
     "TABLE_FILENAME",
     "CALIBRATION_OPS",
@@ -537,3 +538,30 @@ def hop_latency_us() -> float:
     flat ``_HOP_LATENCY`` byte term, re-denominated in microseconds)."""
     t = active_table()
     return t.launch_us() if t is not None else DEFAULT_LAUNCH_US
+
+
+def device_peak_flops(device) -> float:
+    """Peak (bf16 matmul) FLOP/s of one accelerator chip — the MFU
+    denominator shared by the bench harness and the serve MFU gauge.  TPU
+    generations come from the datasheet table; any other platform prefers
+    the active calibration table's MEASURED ``matmul_gflops`` (an honest
+    achievable-peak on CPU rigs) and falls back to 1e12 so an MFU line
+    still prints rather than dividing by an unknown."""
+    kind = getattr(device, "device_kind", "").lower()
+    plat = getattr(device, "platform", "").lower()
+    if "v6" in kind:
+        return 918e12  # v6e (Trillium) bf16
+    if "v5p" in kind:
+        return 459e12
+    if "v5" in kind or "lite" in kind:
+        return 197e12  # v5e bf16
+    if "v4" in kind:
+        return 275e12
+    if plat == "tpu":
+        return 197e12
+    t = active_table()
+    if t is not None:
+        g = t.meta.get("matmul_gflops")
+        if g:
+            return float(g) * 1e9
+    return 1e12

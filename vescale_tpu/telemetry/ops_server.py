@@ -1,0 +1,186 @@
+"""Live ops endpoints — a tiny stdlib HTTP thread for one serve replica.
+
+The serve loop is observable after the fact (steps.jsonl, Perfetto
+traces), but a fleet dispatcher and a liveness probe need answers DURING
+the run.  This module serves three read-only endpoints from one
+``ThreadingHTTPServer`` daemon thread:
+
+  ``/metrics``   Prometheus text exposition of the live registry (the
+                 exact ``exporters.prometheus_text`` output ``/metrics``
+                 scrapers expect); 503 while telemetry is dormant.
+  ``/healthz``   liveness JSON from the registered health provider
+                 (serve/obs.py: watchdog last-beat age, last-decode-step
+                 age, queue depth, free slots/pages, drain state).
+  ``/router``    the replica's dispatch feed (serve/obs.py: queue depth,
+                 TTFT/ITL percentiles, shed rate, capacity, goodput) — the
+                 JSON a multi-replica router polls to place requests.  The
+                 schema is FROZEN (docs/serving.md): routers are written
+                 against it, so fields are only ever added.
+
+Gating matches the telemetry convention: the port knob
+``VESCALE_SERVE_OPS_PORT`` is OFF by default — :func:`maybe_start`
+returns ``None`` without creating a socket or a thread (the serve loop's
+endpoint-off mode is a literal no-op, asserted by tests).  ``0`` binds an
+OS-assigned free port (read it back from ``OpsServer.port`` /
+``active_server()``); any other value binds that port.  The server binds
+localhost only — fleet exposure is a deployment concern (port-forward or
+sidecar), not something a library should default to.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+__all__ = ["OpsServer", "maybe_start", "active_server"]
+
+Provider = Callable[[], Dict]
+
+_ACTIVE: Optional["OpsServer"] = None
+_LOCK = threading.Lock()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the server instance injects itself as .ops on the handler class
+    server_version = "vescale-ops/1"
+
+    def log_message(self, fmt, *args):  # no per-request stderr spam
+        pass
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        ops: "OpsServer" = self.server.ops  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/metrics":
+            self._metrics()
+        elif path in ("/healthz", "/router"):
+            self._json(ops.providers.get(path.lstrip("/")))
+        else:
+            self._send(404, "text/plain; charset=utf-8",
+                       "not found (endpoints: /metrics /healthz /router)\n")
+
+    # ------------------------------------------------------------ bodies
+    def _metrics(self) -> None:
+        from . import api as _tel
+        from .exporters import prometheus_text
+
+        reg = _tel.get_registry()
+        if reg is None:
+            self._send(503, "text/plain; charset=utf-8",
+                       "telemetry dormant (call telemetry.init())\n")
+            return
+        self._send(200, "text/plain; version=0.0.4; charset=utf-8",
+                   prometheus_text(reg))
+
+    def _json(self, provider: Optional[Provider]) -> None:
+        if provider is None:
+            self._send(503, "text/plain; charset=utf-8",
+                       "no provider registered for this endpoint\n")
+            return
+        try:
+            body = json.dumps(provider(), sort_keys=True)
+        except Exception as e:  # a probe must see the failure, not a hang
+            self._send(500, "text/plain; charset=utf-8", f"provider error: {e}\n")
+            return
+        self._send(200, "application/json", body + "\n")
+
+    def _send(self, code: int, ctype: str, body: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class OpsServer:
+    """One replica's ops endpoints on a daemon thread.
+
+        srv = OpsServer(port=0).start()          # 0 = OS-assigned
+        srv.register("healthz", health_fn)       # fn() -> JSON-able dict
+        srv.register("router", router_fn)
+        ... GET http://127.0.0.1:{srv.port}/healthz ...
+        srv.stop()
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self.host = host
+        self.providers: Dict[str, Provider] = {}
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.ops = self  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def register(self, endpoint: str, provider: Provider) -> "OpsServer":
+        if endpoint not in ("healthz", "router"):
+            raise ValueError(f"unknown ops endpoint {endpoint!r}")
+        self.providers[endpoint] = provider
+        return self
+
+    def start(self) -> "OpsServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="vescale-ops-server",
+                kwargs={"poll_interval": 0.05}, daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        global _ACTIVE
+        t, self._thread = self._thread, None
+        if t is not None:
+            self._httpd.shutdown()
+            t.join(timeout=5.0)
+        self._httpd.server_close()
+        with _LOCK:
+            if _ACTIVE is self:
+                _ACTIVE = None
+
+    def __enter__(self) -> "OpsServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def maybe_start(
+    health: Optional[Provider] = None,
+    router: Optional[Provider] = None,
+    port: Optional[int] = None,
+) -> Optional[OpsServer]:
+    """The serve loop's gate: start an :class:`OpsServer` when
+    ``VESCALE_SERVE_OPS_PORT`` is set (``port`` overrides), else do
+    NOTHING — no socket, no thread, return ``None``.  The started server
+    is registered as the process's :func:`active_server` so pollers
+    launched elsewhere (tests, smoke scripts) can find the bound port."""
+    global _ACTIVE
+    if port is None:
+        from ..analysis import envreg
+
+        port = envreg.get_int("VESCALE_SERVE_OPS_PORT")
+    if port is None:
+        return None
+    srv = OpsServer(port=int(port))
+    if health is not None:
+        srv.register("healthz", health)
+    if router is not None:
+        srv.register("router", router)
+    srv.start()
+    with _LOCK:
+        _ACTIVE = srv
+    return srv
+
+
+def active_server() -> Optional[OpsServer]:
+    """The most recent :func:`maybe_start` server still running, if any."""
+    return _ACTIVE
