@@ -1341,6 +1341,16 @@ def _dispatch():
         import redistribute_bench
 
         print(json.dumps(redistribute_bench.run_bench()))
+    elif which == "fleet":
+        # multi-replica fleet rung (VESCALE_BENCH=fleet): aggregate
+        # tokens/s, fleet p99 TTFT and shed rate under a 5x-capacity
+        # overload with a mid-run replica kill + rejoin, plus the
+        # router-hop-vs-direct-submit overhead line (<1% bar) —
+        # scripts/fleet_smoke.py emits the line
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import fleet_smoke
+
+        print(json.dumps(fleet_smoke.run_bench()))
     elif which == "quantcomm":
         # quantized gradient collectives (VESCALE_BENCH=quantcomm): the
         # 2-proc gloo rig's grad-reduce bytes-on-the-wire + step time,

@@ -20,6 +20,8 @@ echo "=== resilient serving smoke (train@2 -> serve@1 bit-identical, coordinated
 python scripts/serve_smoke.py || failed=1
 echo "=== serve observability smoke (request span chains ledger-matched, live ops endpoints)"
 python scripts/serve_obs_smoke.py || failed=1
+echo "=== fleet smoke (multi-replica router: kill mid-load -> failover -> rejoin, ledger balanced)"
+python scripts/fleet_smoke.py || failed=1
 for f in tests/test_*.py; do
   echo "=== $f"
   python -m pytest "$f" -q || failed=1

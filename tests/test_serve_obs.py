@@ -189,16 +189,27 @@ def test_maybe_start_auto_port_and_active_registry(monkeypatch):
 
 # ===================================================== providers / schema
 def test_router_schema_frozen_and_json_roundtrip(serve_rig):
+    from vescale_tpu.serve.obs import ROUTER_FIELDS_V1
+
     eng, cache = serve_rig
     cache.reset()
     sched = ContinuousBatchingScheduler(cache, max_queue=8)
-    obs = ServeObservability(sched, engine=eng, rank=0)
+    obs = ServeObservability(sched, engine=eng, rank=0, replica_id="robs")
     feed = json.loads(json.dumps(obs.router()))
     assert set(feed) == set(ROUTER_FIELDS)
-    assert feed["schema_version"] == ROUTER_SCHEMA_VERSION
+    # the freeze contract across versions: fields are only ever ADDED —
+    # v1 stays a strict subset, so a router written against v1 still runs
+    assert ROUTER_FIELDS_V1 < ROUTER_FIELDS
+    assert set(ROUTER_FIELDS) - set(ROUTER_FIELDS_V1) == {"replica_id", "accepting"}
+    assert feed["schema_version"] == ROUTER_SCHEMA_VERSION == 2
     assert feed["slots"] == 2 and feed["free_slots"] == 2
     assert set(feed["ttft_s"]) == {"p50", "p95", "p99"}
     assert set(feed["itl_s"]) == {"p50", "p95", "p99"}
+    # v2 additions: identity + the pre-dispatch exclusion signal
+    assert feed["replica_id"] == "robs"
+    assert feed["accepting"] is True
+    obs.draining = True
+    assert obs.router()["accepting"] is False
 
 
 def test_healthz_reports_watchdog_beat_age(serve_rig):

@@ -274,6 +274,8 @@ register("VESCALE_FAULTSIM_HANG_S", "float", 3600.0,
          "Stall duration in seconds for the faultsim `hang` kind (watchdog test fodder).")
 register("VESCALE_FAULTSIM_SLOW_DECODE_S", "float", 0.05,
          "Stall duration in seconds for the faultsim `slow_decode` kind (serve-loop straggler injection).")
+register("VESCALE_FAULTSIM_KILL_EXIT_CODE", "int", 29,
+         "Process exit code of the faultsim `replica_kill` kind (an abrupt os._exit mid-decode — the fleet failover test substrate).")
 register("VESCALE_WATCHDOG_TIMEOUT", "float", 0.0,
          "Hang-watchdog step-progress deadline in seconds; unset or <=0 disables the watchdog.")
 register("VESCALE_WATCHDOG_ABORT", "bool", True,
@@ -304,6 +306,30 @@ register("VESCALE_SERVE_DEADLINE_S", "float", 0.0,
          "Default per-request wall-clock deadline in seconds (timeout cancellation); 0 disables (requests may still carry explicit deadlines).")
 register("VESCALE_SERVE_OPS_PORT", "int", None,
          "Localhost port for the serve loop's live ops endpoints (`/metrics`, `/healthz`, `/router`): unset = endpoints off (no thread, no socket), 0 = auto-assign a free port (docs/serving.md).")
+register("VESCALE_SERVE_REPLICA_ID", "str", None,
+         "Stable replica identity published in the `/router` v2 feed (`replica_id`) and used by the fleet router's affinity ring; unset = `rank<process_index>`.")
+register("VESCALE_SERVE_IDLE_S", "float", 0.002,
+         "Step-boundary sleep of an inbox-fed serve loop with nothing queued or in flight (keeps an idle replica from spinning a core while staying responsive to new submissions).")
+
+# --- fleet router (multi-replica serving) ----------------------------
+register("VESCALE_FLEET_POLL_S", "float", 0.05,
+         "Fleet router poll cadence in seconds for each replica's `/router` feed (docs/serving.md fleet section).")
+register("VESCALE_FLEET_POLL_TIMEOUT_S", "float", 2.0,
+         "Per-request HTTP timeout in seconds for fleet router polls and submits; a slower reply counts as a breaker failure.")
+register("VESCALE_FLEET_BREAKER_FAILURES", "int", 3,
+         "Consecutive poll/submit failures that open a replica's circuit breaker (dispatch stops until a half-open probe succeeds).")
+register("VESCALE_FLEET_BREAKER_COOLDOWN_S", "float", 1.0,
+         "Seconds an open breaker waits before its next poll becomes the half-open readmission probe; a failed probe re-opens with a fresh cooldown.")
+register("VESCALE_FLEET_HEALTH_STALE_S", "float", 10.0,
+         "A reachable replica whose `/router` `serve_step` has not advanced for this long is treated as wedged (breaker failure); 0 disables staleness detection.")
+register("VESCALE_FLEET_RETRIES", "int", 3,
+         "Bounded dispatch attempts per request placement (first dispatch, failover and spill-over alike) before the fleet sheds it.")
+register("VESCALE_FLEET_BACKOFF_S", "float", 0.05,
+         "First retry backoff sleep in seconds for fleet dispatch (exponential from here).")
+register("VESCALE_FLEET_BACKOFF_MAX_S", "float", 2.0,
+         "Fleet dispatch backoff ceiling in seconds.")
+register("VESCALE_FLEET_HEDGE_S", "float", 0.0,
+         "Tail-latency hedge bound in seconds: a request unresolved this long after dispatch is sent to a SECOND replica (first terminal outcome wins — decode determinism keeps the answers identical); 0 disables hedging.")
 
 # --- trace timeline / cost calibration -------------------------------
 register("VESCALE_COST_CALIBRATION", "str", None,

@@ -56,6 +56,19 @@ Fault kinds and their hook sites:
                     sleeps ``VESCALE_FAULTSIM_SLOW_DECODE_S`` (default
                     0.05) seconds, simulating a straggling decode so
                     latency-SLO shedding and the p99 budget are testable
+  replica_kill      observed by ``run_serve_resilient`` — after the Nth
+                    decode step WITH in-flight work the process dies
+                    abruptly (``os._exit`` with
+                    ``VESCALE_FAULTSIM_KILL_EXIT_CODE``, default 29):
+                    no drain, no cleanup — the crashed-replica substrate
+                    the fleet router's failover path is proven against
+                    (scripts/fleet_smoke.py)
+  poll_blackhole    observed by ``telemetry.ops_server`` — a due
+                    ``/router`` or ``/healthz`` GET is answered with an
+                    abrupt connection close (no bytes), simulating a
+                    network partition between a healthy replica and the
+                    fleet router's poller: the breaker opens without the
+                    replica dying, and readmission is probe-driven
   ================  ====================================================
 
 Gating contract (the ``telemetry.init()`` pattern): while disarmed the
@@ -99,6 +112,8 @@ KINDS = (
     "resize",
     "request_timeout",
     "slow_decode",
+    "replica_kill",
+    "poll_blackhole",
 )
 
 # errors raised by `check` per kind; observation-level kinds (nonfinite_loss,

@@ -19,11 +19,19 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from . import obs, reqtrace
+from . import fleet, obs, reqtrace, router
 from .engine import ServeEngine
+from .fleet import FleetSupervisor, ReplicaSpec, RequestInbox, serve_replica
 from .kv_cache import KVCacheConfig, KVCacheOutOfPages, PagedKVCache
 from .loop import ServeResult, run_serve_resilient
 from .obs import ServeObservability
+from .router import (
+    CircuitBreaker,
+    ConsistentHashRing,
+    FleetLedger,
+    FleetRouter,
+    HttpReplicaClient,
+)
 from .scheduler import ContinuousBatchingScheduler, Request, ShedError
 
 __all__ = [
@@ -38,8 +46,19 @@ __all__ = [
     "ServeObservability",
     "run_serve_resilient",
     "load_params",
+    "CircuitBreaker",
+    "ConsistentHashRing",
+    "FleetLedger",
+    "FleetRouter",
+    "HttpReplicaClient",
+    "RequestInbox",
+    "ReplicaSpec",
+    "FleetSupervisor",
+    "serve_replica",
     "obs",
     "reqtrace",
+    "router",
+    "fleet",
 ]
 
 

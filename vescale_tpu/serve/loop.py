@@ -48,6 +48,7 @@ multi-replica router.
 
 from __future__ import annotations
 
+import os
 import time
 import zlib
 from dataclasses import dataclass, field
@@ -102,6 +103,10 @@ def run_serve_resilient(
     coordinate: Optional[bool] = None,
     barrier_timeout_s: Optional[float] = None,
     on_step: Optional[Callable[[int, int], None]] = None,
+    inbox: Optional[Any] = None,
+    ops: Optional[Any] = None,
+    idle_sleep_s: Optional[float] = None,
+    replica_id: Optional[str] = None,
 ) -> ServeResult:
     """Serve ``arrivals`` (a deterministic open-loop schedule of
     ``(arrival_step, Request)`` pairs, ascending) to completion under the
@@ -117,6 +122,15 @@ def run_serve_resilient(
     The loop never loses a request: a mid-batch fault evicts and REPLAYS
     the newest request; a drain rejects queued requests re-queueable; a
     deadline rejects explicitly.  ``ServeResult.outcomes`` is the ledger.
+
+    Fleet mode (serve/fleet.py): ``inbox`` (a ``RequestInbox``) feeds the
+    loop NETWORK submissions — drained into ``scheduler.submit`` at every
+    step boundary, with an ``VESCALE_SERVE_IDLE_S`` sleep when the
+    replica is fully idle so an empty replica does not spin; the loop
+    then runs until the inbox is closed (or a preemption drain).  ``ops``
+    injects a pre-started ``OpsServer`` (the caller owns its lifecycle —
+    it can keep serving final outcomes after the loop returns); without
+    it the loop starts/stops its own via ``VESCALE_SERVE_OPS_PORT``.
     """
     import jax
 
@@ -165,9 +179,18 @@ def run_serve_resilient(
     # HTTP thread starts ONLY when VESCALE_SERVE_OPS_PORT is set (off by
     # default — maybe_start returns None without creating a thread)
     obs = ServeObservability(
-        scheduler, engine=engine, watchdog=wd, rank=jax.process_index()
+        scheduler, engine=engine, watchdog=wd, rank=jax.process_index(),
+        replica_id=replica_id,
     )
-    ops = _ops.maybe_start(health=obs.health, router=obs.router)
+    if ops is not None:
+        # a pre-started server (serve/fleet.py): register the live
+        # providers on it; the CALLER owns start/stop — it may keep the
+        # port serving final outcomes after this loop returns
+        ops.register("healthz", obs.health).register("router", obs.router)
+        own_ops = False
+    else:
+        ops = _ops.maybe_start(health=obs.health, router=obs.router)
+        own_ops = ops is not None
     # cold-start retry_after_s seed: with a calibration table armed the
     # decode step is priceable before anything has run; the first prefill
     # wall time (below) covers the un-calibrated case
@@ -286,6 +309,10 @@ def run_serve_resilient(
                 )
             _fs.set_step(step)
             _beat(step, "boundary")
+            # liveness, not just decode progress: the /router feed's
+            # serve_step advances every boundary, so a fleet router can
+            # tell "idle" from "wedged" (stale-feed breaker trip)
+            obs.serve_step = step
             if _fs.fires("hang", ctx=f"serve_step{step}"):
                 # wedged decode: stall past every deadline — the watchdog's
                 # detect/dump/abort path is the only way out, as in training
@@ -304,6 +331,20 @@ def run_serve_resilient(
                 _, req = arrivals[next_arrival]
                 next_arrival += 1
                 scheduler.submit(req, step)
+            if inbox is not None:
+                # network submissions (fleet mode): drained at the step
+                # boundary so scheduler state stays single-threaded; a
+                # malformed/duplicate wire submission is rejected and
+                # counted, never allowed to kill the serving loop.
+                # Mid-drain arrivals still enter the ledger — the exit
+                # flush below terminates them preempted_requeue.
+                for req in inbox.drain():
+                    try:
+                        scheduler.submit(req, step)
+                    except ValueError as e:
+                        _tel.count("serve_inbox_rejected_total")
+                        _event("inbox_reject", rid=getattr(req, "rid", -1),
+                               at_step=step, error=str(e))
 
             # ------------------------------------------- control plane
             # wall-deadline verdicts are rank-LOCAL clock reads: compute
@@ -357,10 +398,25 @@ def run_serve_resilient(
             if (
                 not draining
                 and next_arrival >= len(arrivals)
+                and (inbox is None or inbox.closed)
                 and scheduler.all_terminal()
             ):
-                result.status = "completed"
-                break
+                # close() may have raced this iteration's drain: anything
+                # push()ed before the close is still owed service — drain
+                # once more and only exit when the inbox is truly empty
+                # (push-after-close is refused at push(), so this final
+                # drain is exhaustive)
+                late = inbox.drain() if inbox is not None else ()
+                if not late:
+                    result.status = "completed"
+                    break
+                for req in late:
+                    try:
+                        scheduler.submit(req, step)
+                    except ValueError as e:
+                        _tel.count("serve_inbox_rejected_total")
+                        _event("inbox_reject", rid=getattr(req, "rid", -1),
+                               at_step=step, error=str(e))
 
             # ---------------------------------------------- admit + decode
             if not draining:
@@ -398,6 +454,16 @@ def run_serve_resilient(
                     )
                 _tel.count("serve_decode_steps_total")
                 obs.on_decode_step(step, dt, len(active_slots))
+                if _fs.fires("replica_kill", ctx=f"serve_step{step}"):
+                    # an abrupt replica crash MID-LOAD (consulted only on
+                    # decode steps with in-flight work, so the kill always
+                    # strands requests for the fleet router to fail over):
+                    # no drain, no cleanup, no ledger flush — os._exit is
+                    # the point.  The supervisor restart + elastic restore
+                    # path brings the replica back.
+                    _event("replica_kill", at_step=step,
+                           inflight=len(scheduler.active))
+                    os._exit(envreg.get_int("VESCALE_FAULTSIM_KILL_EXIT_CODE"))
                 if draining:
                     before = scheduler.counts["completed"]
                     _finish_done(step)
@@ -427,12 +493,27 @@ def run_serve_resilient(
                 )
             if on_step is not None:
                 on_step(step, len(scheduler.active))
+            if (
+                inbox is not None
+                and not draining
+                and not scheduler.active
+                and not scheduler.queue
+                and next_arrival >= len(arrivals)
+            ):
+                # fully idle inbox-fed replica: don't spin a core at the
+                # boundary rate — sleep one idle slice (the loop keeps
+                # iterating, so watchdog beats and /router liveness
+                # (serve_step) keep advancing while idle)
+                if idle_sleep_s is None:
+                    idle_sleep_s = envreg.get_float("VESCALE_SERVE_IDLE_S")
+                if idle_sleep_s:
+                    time.sleep(idle_sleep_s)
             step += 1
     finally:
         result.steps = step
         result.outcomes = dict(scheduler.outcomes)
         result.counts = dict(scheduler.counts)
-        if ops is not None:
+        if own_ops and ops is not None:
             ops.stop()
         if own_wd:
             wd.stop()

@@ -30,12 +30,18 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
-__all__ = ["ServeObservability", "ROUTER_SCHEMA_VERSION", "ROUTER_FIELDS"]
+__all__ = [
+    "ServeObservability",
+    "ROUTER_SCHEMA_VERSION",
+    "ROUTER_FIELDS",
+    "ROUTER_FIELDS_V1",
+]
 
-ROUTER_SCHEMA_VERSION = 1
-# the frozen /router field set (schema v1) — tests assert the payload
-# carries exactly these keys, docs/serving.md documents their meaning
-ROUTER_FIELDS = frozenset(
+ROUTER_SCHEMA_VERSION = 2
+# the frozen /router v1 field set: the freeze contract says fields are
+# only ever ADDED — v1 must remain a strict subset of every later version
+# (tests assert it), so a router written against v1 keeps working
+ROUTER_FIELDS_V1 = frozenset(
     (
         "schema_version",
         "rank",
@@ -58,6 +64,11 @@ ROUTER_FIELDS = frozenset(
         "uptime_s",
     )
 )
+# schema v2 (additive only, per the freeze contract): `replica_id` (the
+# fleet router's stable dispatch/affinity identity) and `accepting`
+# (False while draining or actively shedding — the pre-dispatch
+# exclusion signal).  docs/serving.md documents the v1 -> v2 delta.
+ROUTER_FIELDS = ROUTER_FIELDS_V1 | frozenset(("replica_id", "accepting"))
 
 
 def _pcts(hist) -> Dict[str, Optional[float]]:
@@ -71,11 +82,21 @@ def _pcts(hist) -> Dict[str, Optional[float]]:
 class ServeObservability:
     """Derived-rate bookkeeping + endpoint providers for one serve loop."""
 
-    def __init__(self, scheduler, engine=None, watchdog=None, rank: int = 0):
+    def __init__(self, scheduler, engine=None, watchdog=None, rank: int = 0,
+                 replica_id: Optional[str] = None):
+        from ..analysis import envreg
+
         self.scheduler = scheduler
         self.engine = engine
         self.watchdog = watchdog
         self.rank = int(rank)
+        # stable fleet identity (schema v2): explicit arg, else the env
+        # knob (one replica process = one id), else the rank
+        self.replica_id = (
+            replica_id
+            or envreg.get_str("VESCALE_SERVE_REPLICA_ID")
+            or f"rank{self.rank}"
+        )
         self.draining = False  # the loop flips it; /healthz reports it
         self.serve_step = 0
         self.decode_steps = 0
@@ -156,9 +177,15 @@ class ServeObservability:
         cache = sched.cache
         now = time.perf_counter()
         wd = self.watchdog
+        shedding = sched.currently_shedding()
         return {
             "ok": not self.draining,
             "draining": self.draining,
+            "replica_id": self.replica_id,
+            # admission-control state + the same hint a shed client gets:
+            # the ops server turns these into a Retry-After header
+            "shedding": shedding,
+            "retry_after_s": sched.retry_after_s(),
             "serve_step": self.serve_step,
             "decode_steps": self.decode_steps,
             "queue_depth": len(sched.queue),
@@ -178,7 +205,8 @@ class ServeObservability:
 
     def router(self) -> Dict:
         """`/router`: the dispatch feed a multi-replica router polls —
-        FROZEN schema v1 (ROUTER_FIELDS; docs/serving.md)."""
+        FROZEN schema, v2 (ROUTER_FIELDS; docs/serving.md has the
+        v1 -> v2 delta — fields are only ever added)."""
         sched = self.scheduler
         cache = sched.cache
         up = max(1e-9, time.perf_counter() - self._start)
@@ -186,7 +214,11 @@ class ServeObservability:
         out = {
             "schema_version": ROUTER_SCHEMA_VERSION,
             "rank": self.rank,
+            "replica_id": self.replica_id,
             "draining": self.draining,
+            # the pre-dispatch exclusion signal: False while draining OR
+            # while admission control would shed a submission right now
+            "accepting": not self.draining and sched.currently_shedding() is None,
             "queue_depth": len(sched.queue),
             "inflight": len(sched.active),
             "slots": cache.num_slots,

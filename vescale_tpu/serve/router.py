@@ -1,0 +1,959 @@
+"""Fleet router — multi-replica dispatch with failure detection, request
+failover, and a zero-loss fleet ledger.
+
+PR 10 made ONE serve replica survive the fault battery; PR 12 froze the
+``/router`` feed "so the future dispatcher can be written against it".
+This module is that dispatcher (ROADMAP item 3; the replica-level
+scheduling framing of arXiv:2309.06180): the unit of recovery grows from
+a rank to a **replica** — N ``run_serve_resilient`` processes behind one
+front-end that places requests, notices replicas dying, and re-drives
+their in-flight work somewhere healthy.
+
+Design:
+
+  * **Polling, not push.**  The router learns everything from each
+    replica's frozen ``/router`` feed (schema v1 consumable, v2 fields
+    used when present) at ``VESCALE_FLEET_POLL_S`` cadence — queue depth,
+    TTFT percentiles, free slots, ``retry_after_s``, ``accepting``.  No
+    replica-side router awareness: a replica that predates the fleet
+    still routes.
+  * **Least-loaded scoring** — ``(queue_depth + inflight +
+    locally-dispatched-since-last-poll) / slots + p99 TTFT seconds``,
+    lowest wins, ties broken by least-recently-dispatched then replica
+    id (deterministic).  The local-dispatch term keeps a burst between
+    two polls from piling onto one replica.
+  * **Session affinity** — consistent hashing (crc32 ring, virtual
+    nodes) on an opaque session key, for future prefix-cache locality:
+    the same session lands on the same replica while it stays healthy,
+    and replica churn only remaps the keys that hashed to the dead node.
+  * **Circuit breaker per replica** — ``VESCALE_FLEET_BREAKER_FAILURES``
+    consecutive poll/submit failures (or a feed whose ``serve_step``
+    stops advancing for ``VESCALE_FLEET_HEALTH_STALE_S`` — a reachable
+    but wedged replica) opens the breaker; after
+    ``VESCALE_FLEET_BREAKER_COOLDOWN_S`` the next poll is a HALF-OPEN
+    probe — success closes and readmits the replica to the rotation,
+    failure re-opens with a fresh cooldown.
+  * **Request failover** — when a breaker opens, every request in-flight
+    on that replica is re-dispatched **from the prompt** to a healthy
+    one (decode is deterministic, so the replayed tokens are
+    bit-identical).  The resubmission is counted, never hidden.
+  * **Total accounting at fleet scope** — every request submitted to the
+    router ends in EXACTLY one terminal outcome *across the fleet*
+    (``completed`` / ``shed`` / ``timed_out`` / ``preempted_requeue``),
+    no matter how many replicas it visited; :meth:`FleetLedger.check`
+    asserts it (the fleet-smoke invariant: a replica kill can never lose
+    or duplicate a request).
+  * **Backpressure honored** — a replica-side ``shed`` outcome (or a
+    ``Retry-After`` header) backs the replica off for its own
+    ``retry_after_s`` hint; the router only sheds at FLEET level when
+    every healthy replica is shedding (the degradation order: spill to
+    peers first, reject only when the whole fleet is saturated).
+  * **Deadline propagation** — ``deadline_steps`` rides the submit
+    payload verbatim (the replica enforces it); a wall ``deadline_s``
+    is enforced by the router: it bounds every retry/backoff sleep, and
+    an unresolved request past it is terminally ``timed_out`` (a late
+    replica completion is superseded — wasted work, visible in the
+    goodput gap, never a duplicate outcome).
+  * **Hedging (off by default)** — with ``VESCALE_FLEET_HEDGE_S > 0`` a
+    request still unresolved after the bound is dispatched to a SECOND
+    replica; the first terminal outcome wins and the loser is ignored
+    (decode determinism makes either answer identical; the ledger
+    counts the hedge, and duplicates stay impossible because the fleet
+    record resolves exactly once).
+
+Transport is pluggable: :class:`HttpReplicaClient` speaks to a live
+``telemetry.ops_server`` over localhost urllib; tests drive the same
+router with in-memory fakes (no sockets) — the breaker/affinity/ledger
+state machines are transport-blind.  Clock and sleep are injectable for
+deterministic unit tests.
+
+Telemetry rides the gated registry (``fleet:`` dashboard block):
+``fleet_dispatch_total``, ``fleet_redispatch_total``,
+``fleet_failover_total``, ``fleet_hedge_total``, ``fleet_shed_total``,
+``fleet_poll_failures_total``, ``fleet_breaker_{open,reopen,close}_total``
+and the ``fleet_healthy_replicas`` / ``fleet_pending_requests`` gauges.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import json
+import time
+import urllib.error
+import urllib.request
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .scheduler import Request, TERMINAL
+
+__all__ = [
+    "ReplicaUnreachable",
+    "CircuitBreaker",
+    "ConsistentHashRing",
+    "FleetLedger",
+    "FleetRouter",
+    "HttpReplicaClient",
+    "request_payload",
+    "request_from_payload",
+]
+
+
+class ReplicaUnreachable(RuntimeError):
+    """A poll or submit against a replica failed at the transport level
+    (connection refused, timeout, blackholed reply, malformed body)."""
+
+
+# --------------------------------------------------------------- payloads
+def request_payload(
+    req: Request, session: Optional[str] = None, tag: Optional[int] = None
+) -> Dict[str, Any]:
+    """The wire form of a :class:`Request` (the POST ``/submit`` body).
+    ``deadline_steps`` rides verbatim — the replica enforces it.  ``tag``
+    (default: the request's own) is the dispatch-attempt token the
+    replica echoes into the outcome row."""
+    d: Dict[str, Any] = {
+        "rid": req.rid,
+        "prompt": list(req.prompt),
+        "max_new_tokens": req.max_new_tokens,
+    }
+    if req.eos_id is not None:
+        d["eos_id"] = req.eos_id
+    if req.deadline_steps is not None:
+        d["deadline_steps"] = req.deadline_steps
+    if session is not None:
+        d["session"] = session
+    if tag is None:
+        tag = req.tag
+    if tag is not None:
+        d["tag"] = tag
+    return d
+
+
+def request_from_payload(d: Dict[str, Any]) -> Request:
+    """Parse a ``/submit`` body back into a :class:`Request` (validation
+    is the dataclass's — empty prompts and bad budgets raise here, on the
+    serving side of the wire)."""
+    return Request(
+        rid=int(d["rid"]),
+        prompt=tuple(int(t) for t in d["prompt"]),
+        max_new_tokens=int(d.get("max_new_tokens", 16)),
+        eos_id=(None if d.get("eos_id") is None else int(d["eos_id"])),
+        deadline_steps=(
+            None if d.get("deadline_steps") is None else int(d["deadline_steps"])
+        ),
+        tag=(None if d.get("tag") is None else int(d["tag"])),
+    )
+
+
+# --------------------------------------------------------- circuit breaker
+class CircuitBreaker:
+    """Per-replica failure gate: CLOSED -> (N consecutive failures) ->
+    OPEN -> (cooldown) -> HALF_OPEN probe -> CLOSED on success, back to
+    OPEN on probe failure.  ``now_fn`` is injectable so the state machine
+    is unit-testable without sleeping."""
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(
+        self,
+        failures: Optional[int] = None,
+        cooldown_s: Optional[float] = None,
+        now_fn: Callable[[], float] = time.monotonic,
+    ):
+        from ..analysis import envreg
+
+        self.failure_threshold = (
+            failures
+            if failures is not None
+            else envreg.get_int("VESCALE_FLEET_BREAKER_FAILURES")
+        )
+        self.cooldown_s = (
+            cooldown_s
+            if cooldown_s is not None
+            else envreg.get_float("VESCALE_FLEET_BREAKER_COOLDOWN_S")
+        )
+        self._now = now_fn
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.opened_at: Optional[float] = None
+        self.opens = 0  # CLOSED->OPEN transitions
+        self.reopens = 0  # HALF_OPEN probe failures
+        self.closes = 0  # HALF_OPEN->CLOSED readmissions
+
+    def record_success(self) -> None:
+        if self.state == self.HALF_OPEN:
+            self.closes += 1
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.opened_at = None
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if self.state == self.HALF_OPEN:
+            # the probe itself failed: straight back to OPEN, fresh cooldown
+            self.state = self.OPEN
+            self.opened_at = self._now()
+            self.reopens += 1
+        elif (
+            self.state == self.CLOSED
+            and self.consecutive_failures >= self.failure_threshold
+        ):
+            self.state = self.OPEN
+            self.opened_at = self._now()
+            self.opens += 1
+
+    def poll_disposition(self) -> str:
+        """What the next poll of this replica is: ``"poll"`` (normal),
+        ``"probe"`` (half-open trial), or ``"skip"`` (open, cooling)."""
+        if self.state == self.CLOSED:
+            return "poll"
+        if self.state == self.OPEN:
+            if self._now() - (self.opened_at or 0.0) >= self.cooldown_s:
+                self.state = self.HALF_OPEN
+                return "probe"
+            return "skip"
+        return "probe"  # HALF_OPEN
+
+    @property
+    def dispatchable(self) -> bool:
+        """Requests are only placed on CLOSED replicas; a HALF_OPEN
+        replica earns readmission with a successful *poll* probe first."""
+        return self.state == self.CLOSED
+
+
+# ------------------------------------------------------- consistent hashing
+class ConsistentHashRing:
+    """crc32 hash ring with virtual nodes — deterministic across
+    processes (no salted ``hash()``), stable under churn: removing a node
+    only remaps the keys that hashed to it."""
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = int(vnodes)
+        self._points: List[Tuple[int, str]] = []  # sorted (hash, node)
+
+    @staticmethod
+    def _h(s: str) -> int:
+        return zlib.crc32(s.encode())
+
+    def add(self, node: str) -> None:
+        for i in range(self.vnodes):
+            bisect.insort(self._points, (self._h(f"{node}#{i}"), node))
+
+    def remove(self, node: str) -> None:
+        self._points = [(h, n) for h, n in self._points if n != node]
+
+    def nodes(self) -> Tuple[str, ...]:
+        return tuple(sorted({n for _, n in self._points}))
+
+    def lookup(self, key: str, eligible: Sequence[str]) -> Optional[str]:
+        """The first eligible node at or after ``key``'s ring position
+        (wrapping).  ``eligible`` filters without mutating the ring, so a
+        replica's points survive its outage — when it heals, its sessions
+        come home."""
+        if not self._points:
+            return None
+        ok = set(eligible)
+        if not ok:
+            return None
+        start = bisect.bisect_left(self._points, (self._h(f"k:{key}"), ""))
+        n = len(self._points)
+        for off in range(n):
+            node = self._points[(start + off) % n][1]
+            if node in ok:
+                return node
+        return None
+
+
+# ------------------------------------------------------------ fleet ledger
+@dataclasses.dataclass
+class FleetRecord:
+    """One request's fleet-wide lifetime: where it has been dispatched,
+    how many times it was re-driven, and the single terminal outcome."""
+
+    req: Request
+    session: Optional[str] = None
+    deadline_at: Optional[float] = None  # router-clock absolute wall bound
+    status: Optional[str] = None  # a TERMINAL string once resolved
+    outcome: Optional[Dict[str, Any]] = None  # the winning replica record
+    replica: Optional[str] = None  # replica that resolved it
+    live_on: List[str] = dataclasses.field(default_factory=list)
+    # dispatch-attempt token per replica: an /outcomes row whose echoed
+    # tag differs is a STALE row from a prior dispatch of this rid there
+    # (tags are router-unique, so rows can never alias across attempts
+    # or client resubmissions)
+    tag_by_replica: Dict[str, int] = dataclasses.field(default_factory=dict)
+    attempts: List[Tuple[str, float]] = dataclasses.field(default_factory=list)
+    resubmissions: int = 0
+    failovers: int = 0
+    hedged: bool = False
+    submitted_at: float = 0.0
+    resolved_at: Optional[float] = None
+    last_dispatch_at: float = 0.0
+
+    @property
+    def pending(self) -> bool:
+        return self.status is None
+
+
+class FleetLedger:
+    """Fleet-scope total accounting: every rid submitted to the router
+    resolves to EXACTLY one terminal outcome, resubmissions counted.
+    The multi-replica analog of ``ContinuousBatchingScheduler``'s ledger
+    — :meth:`check` is what the fleet smoke asserts after a replica kill."""
+
+    def __init__(self):
+        self.records: Dict[int, FleetRecord] = {}
+        # pending rids maintained incrementally: submit/pump are on the
+        # dispatch hot path and must stay O(pending), not O(history)
+        self._pending: Dict[int, FleetRecord] = {}
+        self.counts: Dict[str, int] = {
+            "submitted": 0,
+            "dispatched": 0,
+            # client-level: the SAME rid submitted again after a terminal
+            # outcome (the retry_after_s contract) — nets in check()
+            "resubmitted": 0,
+            # fleet-internal: extra placements within one rid lifetime
+            # (failover / shed spill-over / hedge) — informational
+            "redispatched": 0,
+            "failovers": 0,
+            "hedges": 0,
+            "completed": 0,
+            "shed": 0,
+            "timed_out": 0,
+            "preempted_requeue": 0,
+        }
+
+    def submitted(self, rec: FleetRecord) -> None:
+        if rec.req.rid in self.records and self.records[rec.req.rid].pending:
+            raise ValueError(f"duplicate fleet request id {rec.req.rid} (still pending)")
+        prior = self.records.get(rec.req.rid)
+        if prior is not None:
+            # same contract as the replica scheduler: a terminal rid MAY be
+            # resubmitted by the client; the new lifetime supersedes
+            self.counts["resubmitted"] += 1
+        self.records[rec.req.rid] = rec
+        self._pending[rec.req.rid] = rec
+        self.counts["submitted"] += 1
+
+    def dispatched(self, rec: FleetRecord, replica_id: str, now: float) -> None:
+        rec.attempts.append((replica_id, now))
+        rec.last_dispatch_at = now
+        if replica_id not in rec.live_on:
+            rec.live_on.append(replica_id)
+        self.counts["dispatched"] += 1
+
+    def resolve(
+        self, rec: FleetRecord, status: str, outcome: Optional[Dict[str, Any]],
+        replica_id: Optional[str], now: float,
+    ) -> bool:
+        """First terminal wins; a late outcome (hedge loser, a deadline
+        superseded by the router) returns False and changes nothing."""
+        if not rec.pending:
+            return False
+        if status not in TERMINAL:
+            raise ValueError(f"non-terminal fleet status {status!r}")
+        rec.status = status
+        rec.outcome = outcome
+        rec.replica = replica_id
+        rec.resolved_at = now
+        rec.live_on.clear()
+        self.counts[status] += 1
+        self._pending.pop(rec.req.rid, None)
+        return True
+
+    def pending(self) -> List[FleetRecord]:
+        return list(self._pending.values())
+
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    def check(self) -> None:
+        """Assert fleet-wide total accounting (``fleet_ledger_check``):
+        nothing pending, every submission resolved exactly once, terminal
+        counts and the resubmission net agree with the records."""
+        stuck = [r.req.rid for r in self.records.values() if r.pending]
+        if stuck:
+            raise AssertionError(f"fleet_ledger_check: unresolved rids {stuck}")
+        terminal = sum(self.counts[s] for s in TERMINAL)
+        expected = self.counts["submitted"] - self.counts["resubmitted"]
+        if len(self.records) != expected or terminal != self.counts["submitted"]:
+            raise AssertionError(
+                f"fleet_ledger_check: {self.counts['submitted']} submitted "
+                f"({self.counts['resubmitted']} resubmissions) vs "
+                f"{len(self.records)} records / {terminal} terminal counts"
+            )
+        for r in self.records.values():
+            if r.status not in TERMINAL:
+                raise AssertionError(
+                    f"fleet_ledger_check: rid {r.req.rid} status {r.status!r}"
+                )
+
+
+# fleet_ledger_check by its ISSUE name: the smoke calls it off the router
+def fleet_ledger_check(ledger: FleetLedger) -> None:
+    ledger.check()
+
+
+# ---------------------------------------------------------------- clients
+class HttpReplicaClient:
+    """urllib transport against one replica's live ops endpoints
+    (``telemetry.ops_server``).  Every failure — refused, timed out,
+    blackholed, non-JSON — normalizes to :class:`ReplicaUnreachable` so
+    the breaker sees one failure vocabulary."""
+
+    def __init__(self, base_url: str, timeout_s: Optional[float] = None):
+        from ..analysis import envreg
+
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = (
+            timeout_s
+            if timeout_s is not None
+            else envreg.get_float("VESCALE_FLEET_POLL_TIMEOUT_S")
+        )
+        self.last_retry_after_header: Optional[float] = None
+
+    def _get(self, path: str) -> Dict[str, Any]:
+        try:
+            with urllib.request.urlopen(
+                f"{self.base_url}{path}", timeout=self.timeout_s
+            ) as resp:
+                self._capture_retry_after(resp)
+                return json.loads(resp.read().decode())
+        except Exception as e:  # narrow normalization boundary: transport only
+            raise ReplicaUnreachable(f"GET {path} on {self.base_url}: {e}") from e
+
+    def _capture_retry_after(self, resp) -> None:
+        # reset first: a hint captured minutes ago must not leak into an
+        # unrelated later backpressure decision (the field reflects the
+        # LATEST response only)
+        self.last_retry_after_header = None
+        ra = resp.headers.get("Retry-After")
+        if ra is not None:
+            try:
+                self.last_retry_after_header = float(ra)
+            except ValueError:
+                pass
+
+    def poll_router(self) -> Dict[str, Any]:
+        return self._get("/router")
+
+    def poll_health(self) -> Dict[str, Any]:
+        return self._get("/healthz")
+
+    def outcomes(self) -> Dict[str, Any]:
+        return self._get("/outcomes")
+
+    def submit(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            f"{self.base_url}/submit", data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                self._capture_retry_after(resp)
+                return json.loads(resp.read().decode())
+        except Exception as e:
+            raise ReplicaUnreachable(f"POST /submit on {self.base_url}: {e}") from e
+
+
+class _Replica:
+    """Router-side state for one replica: its client, breaker, the last
+    feed, local dispatch count since that feed, and backoff bookkeeping."""
+
+    def __init__(self, replica_id: str, client, breaker: CircuitBreaker):
+        self.id = replica_id
+        self.client = client
+        self.breaker = breaker
+        self.feed: Optional[Dict[str, Any]] = None
+        self.last_poll_at: Optional[float] = None
+        self.pending_local = 0  # dispatches since the feed last refreshed
+        self.backoff_until = 0.0  # replica-shed retry_after_s honor
+        self.last_serve_step: Optional[int] = None
+        self.last_advance_at: Optional[float] = None
+        self.last_dispatch_at = 0.0
+        self.dispatches = 0
+
+
+# ------------------------------------------------------------------ router
+class FleetRouter:
+    """The fleet front-end.  Single-threaded by design: callers drive it
+    with :meth:`submit` / :meth:`pump` (or :meth:`drain`), which keeps
+    every decision deterministic given the feed/outcome sequence — the
+    property the faked-feed unit tests pin."""
+
+    def __init__(
+        self,
+        *,
+        poll_interval_s: Optional[float] = None,
+        breaker_failures: Optional[int] = None,
+        breaker_cooldown_s: Optional[float] = None,
+        health_stale_s: Optional[float] = None,
+        dispatch_retries: Optional[int] = None,
+        backoff_s: Optional[float] = None,
+        backoff_max_s: Optional[float] = None,
+        hedge_s: Optional[float] = None,
+        now_fn: Callable[[], float] = time.monotonic,
+        sleep_fn: Callable[[float], None] = time.sleep,
+    ):
+        from ..analysis import envreg
+
+        def _f(val, knob):
+            return val if val is not None else envreg.get_float(knob)
+
+        self.poll_interval_s = _f(poll_interval_s, "VESCALE_FLEET_POLL_S")
+        self.health_stale_s = _f(health_stale_s, "VESCALE_FLEET_HEALTH_STALE_S")
+        self.dispatch_retries = (
+            dispatch_retries
+            if dispatch_retries is not None
+            else envreg.get_int("VESCALE_FLEET_RETRIES")
+        )
+        self.backoff_s = _f(backoff_s, "VESCALE_FLEET_BACKOFF_S")
+        self.backoff_max_s = _f(backoff_max_s, "VESCALE_FLEET_BACKOFF_MAX_S")
+        self.hedge_s = _f(hedge_s, "VESCALE_FLEET_HEDGE_S")
+        self._breaker_failures = breaker_failures
+        self._breaker_cooldown_s = breaker_cooldown_s
+        self._now = now_fn
+        self._sleep = sleep_fn
+        self.replicas: Dict[str, _Replica] = {}
+        self.ring = ConsistentHashRing()
+        self.ledger = FleetLedger()
+        self._tag_counter = 0  # router-unique dispatch-attempt tokens
+
+    # ---------------------------------------------------------- lifecycle
+    def add_replica(self, replica_id: str, client) -> None:
+        if replica_id in self.replicas:
+            raise ValueError(f"replica {replica_id!r} already registered")
+        breaker = CircuitBreaker(
+            failures=self._breaker_failures,
+            cooldown_s=self._breaker_cooldown_s,
+            now_fn=self._now,
+        )
+        self.replicas[replica_id] = _Replica(replica_id, client, breaker)
+        self.ring.add(replica_id)
+
+    def remove_replica(self, replica_id: str) -> None:
+        """Administrative removal (scale-down).  In-flight work on the
+        replica is failed over exactly as if it had died."""
+        h = self.replicas.pop(replica_id, None)
+        self.ring.remove(replica_id)
+        if h is not None:
+            self._failover_replica(replica_id)
+
+    # ------------------------------------------------------------ polling
+    def poll(self, force: bool = False) -> None:
+        """Refresh the feeds of every replica whose poll is due; open /
+        probe / close breakers as the polls land; fail over in-flight
+        requests off replicas whose breakers opened."""
+        from .. import telemetry as _tel
+
+        now = self._now()
+        for h in list(self.replicas.values()):
+            due = (
+                force
+                or h.last_poll_at is None
+                or now - h.last_poll_at >= self.poll_interval_s
+            )
+            if not due:
+                continue
+            disposition = h.breaker.poll_disposition()
+            if disposition == "skip":
+                continue
+            was_open = h.breaker.state != CircuitBreaker.CLOSED
+            h.last_poll_at = now
+            try:
+                feed = h.client.poll_router()
+                if not isinstance(feed, dict) or "queue_depth" not in feed:
+                    raise ReplicaUnreachable(f"malformed /router feed: {feed!r}")
+            except ReplicaUnreachable:
+                self._record_failure(h, "poll")
+                continue
+            # liveness beyond reachability: a feed whose serve_step stops
+            # advancing is a wedged replica (stale /healthz in ISSUE terms)
+            step = feed.get("serve_step")
+            if step != h.last_serve_step or h.last_advance_at is None:
+                h.last_serve_step = step
+                h.last_advance_at = now
+            elif (
+                self.health_stale_s
+                and now - h.last_advance_at > self.health_stale_s
+            ):
+                self._record_failure(h, "stale")
+                continue
+            h.feed = feed
+            h.pending_local = 0
+            h.breaker.record_success()
+            if was_open and h.breaker.state == CircuitBreaker.CLOSED:
+                _tel.count("fleet_breaker_close_total")
+                _tel.record_event("fleet_readmit", replica=h.id)
+        _tel.set_gauge(
+            "fleet_healthy_replicas",
+            sum(1 for h in self.replicas.values() if h.breaker.dispatchable),
+        )
+
+    def _record_failure(self, h: _Replica, why: str) -> None:
+        from .. import telemetry as _tel
+
+        before = h.breaker.state
+        h.breaker.record_failure()
+        _tel.count("fleet_poll_failures_total")
+        if h.breaker.state == CircuitBreaker.OPEN and before != CircuitBreaker.OPEN:
+            _tel.count(
+                "fleet_breaker_reopen_total"
+                if before == CircuitBreaker.HALF_OPEN
+                else "fleet_breaker_open_total"
+            )
+            _tel.record_event("fleet_breaker_open", replica=h.id, reason=why)
+            if before != CircuitBreaker.HALF_OPEN:
+                # a replica just died/wedged with requests on it: re-drive
+                # them from the prompt on healthy peers NOW, not at the
+                # next outcome poll
+                self._failover_replica(h.id)
+
+    # ------------------------------------------------------------ scoring
+    @staticmethod
+    def score(feed: Dict[str, Any], pending_local: int = 0) -> float:
+        """Least-loaded score (lower is better): backlog per slot plus the
+        p99 TTFT in seconds — occupancy says where room is, the latency
+        tail says where room is a lie."""
+        slots = max(1, int(feed.get("slots") or 1))
+        backlog = (
+            int(feed.get("queue_depth") or 0)
+            + int(feed.get("inflight") or 0)
+            + pending_local
+        )
+        ttft = feed.get("ttft_s") or {}
+        p99 = ttft.get("p99") if isinstance(ttft, dict) else None
+        return backlog / slots + float(p99 or 0.0)
+
+    @staticmethod
+    def _accepting(feed: Optional[Dict[str, Any]]) -> bool:
+        """v2 feeds say it outright; v1 feeds fall back to ``draining``
+        (the freeze contract: the router must run against v1)."""
+        if feed is None:
+            return False
+        if "accepting" in feed:
+            return bool(feed["accepting"])
+        return not feed.get("draining", False)
+
+    def _eligible(self, exclude: Sequence[str] = ()) -> List[_Replica]:
+        now = self._now()
+        return [
+            h
+            for h in self.replicas.values()
+            if h.id not in exclude
+            and h.breaker.dispatchable
+            and h.feed is not None
+            and self._accepting(h.feed)
+            and now >= h.backoff_until
+        ]
+
+    def pick(
+        self, session: Optional[str] = None, exclude: Sequence[str] = ()
+    ) -> Optional[_Replica]:
+        """The dispatch target: session affinity when a key is given
+        (consistent-hash, healthy-filtered), else the least-loaded
+        eligible replica."""
+        elig = self._eligible(exclude)
+        if not elig:
+            return None
+        if session is not None:
+            rid = self.ring.lookup(str(session), [h.id for h in elig])
+            if rid is not None:
+                return self.replicas[rid]
+        return min(
+            elig,
+            key=lambda h: (self.score(h.feed, h.pending_local), h.last_dispatch_at, h.id),
+        )
+
+    # ----------------------------------------------------------- dispatch
+    def submit(
+        self,
+        req: Request,
+        *,
+        session: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> FleetRecord:
+        """Accept a request at fleet scope and dispatch it.  Always
+        returns a record that WILL resolve: if no replica can take it,
+        the record is already terminally ``shed`` (fleet-level shedding —
+        only when every healthy replica is shedding or none is healthy)."""
+        from .. import telemetry as _tel
+
+        now = self._now()
+        rec = FleetRecord(
+            req=req,
+            session=session,
+            deadline_at=(now + deadline_s) if deadline_s else None,
+            submitted_at=now,
+        )
+        self.ledger.submitted(rec)
+        _tel.count("fleet_requests_total")
+        self._dispatch(rec)
+        _tel.set_gauge("fleet_pending_requests", self.ledger.pending_count())
+        return rec
+
+    def _remaining(self, rec: FleetRecord) -> float:
+        if rec.deadline_at is None:
+            return float("inf")
+        return rec.deadline_at - self._now()
+
+    def _dispatch(
+        self, rec: FleetRecord, exclude: Sequence[str] = (), kind: str = "dispatch",
+        allow_shed: bool = True,
+    ) -> bool:
+        """Bounded retry-with-backoff placement.  ``kind`` is the ledger
+        counter bucket: ``dispatch`` (first placement), ``redispatch``
+        (replica shed/drain spill-over), ``failover`` (replica died),
+        ``hedge`` (tail-latency second copy — ``allow_shed=False``: a
+        failed hedge must never terminate a request still live on its
+        original replica)."""
+        from .. import telemetry as _tel
+
+        excluded = list(exclude)
+        backoff = self.backoff_s
+        for attempt in range(max(1, self.dispatch_retries)):
+            if self._remaining(rec) <= 0:
+                self.ledger.resolve(
+                    rec, "timed_out",
+                    {"status": "timed_out", "tokens": [], "reason": "fleet deadline"},
+                    None, self._now(),
+                )
+                _tel.count("fleet_timeout_total")
+                return False
+            self.poll()
+            h = self.pick(session=rec.session, exclude=excluded)
+            if h is None:
+                if not allow_shed:
+                    return False
+                if self._all_healthy_shedding():
+                    # fleet-level shedding: every healthy replica is already
+                    # rejecting — the fleet's own admission control engages
+                    return self._fleet_shed(rec, "every healthy replica shedding")
+                if not any(x.breaker.dispatchable for x in self.replicas.values()):
+                    if attempt + 1 >= self.dispatch_retries:
+                        return self._fleet_shed(rec, "no healthy replica")
+                # replicas exist but none eligible yet (unpolled feeds,
+                # backoffs): bounded wait then try again
+                self._sleep(min(backoff, max(0.0, self._remaining(rec))))
+                backoff = min(backoff * 2, self.backoff_max_s)
+                continue
+            self._tag_counter += 1
+            tag = self._tag_counter
+            try:
+                resp = h.client.submit(
+                    request_payload(rec.req, session=rec.session, tag=tag)
+                )
+            except ReplicaUnreachable:
+                self._record_failure(h, "submit")
+                excluded.append(h.id)
+                self._sleep(min(backoff, max(0.0, self._remaining(rec))))
+                backoff = min(backoff * 2, self.backoff_max_s)
+                continue
+            if not resp.get("accepted", True):
+                # synchronous backpressure: honor the replica's retry hint
+                self._backoff_replica(h, resp.get("retry_after_s"))
+                excluded.append(h.id)
+                continue
+            now = self._now()
+            h.pending_local += 1
+            h.dispatches += 1
+            h.last_dispatch_at = now
+            rec.tag_by_replica[h.id] = tag
+            self.ledger.dispatched(rec, h.id, now)
+            if kind != "dispatch":
+                rec.resubmissions += 1
+                self.ledger.counts["redispatched"] += 1
+                _tel.count("fleet_redispatch_total")
+            if kind == "failover":
+                rec.failovers += 1
+                self.ledger.counts["failovers"] += 1
+                _tel.count("fleet_failover_total")
+            elif kind == "hedge":
+                rec.hedged = True
+                self.ledger.counts["hedges"] += 1
+                _tel.count("fleet_hedge_total")
+            _tel.count("fleet_dispatch_total")
+            _tel.record_event(
+                "fleet_dispatch", rid=rec.req.rid, replica=h.id, dispatch=kind,
+            )
+            return True
+        if not allow_shed:
+            return False
+        return self._fleet_shed(rec, "dispatch retries exhausted")
+
+    def _backoff_replica(self, h: _Replica, retry_after_s) -> None:
+        hint = retry_after_s
+        if hint is None and getattr(h.client, "last_retry_after_header", None):
+            hint = h.client.last_retry_after_header
+        h.backoff_until = self._now() + max(0.01, float(hint or 0.05))
+
+    def _all_healthy_shedding(self) -> bool:
+        healthy = [h for h in self.replicas.values() if h.breaker.dispatchable]
+        now = self._now()
+        return bool(healthy) and all(
+            h.feed is not None
+            and (not self._accepting(h.feed) or now < h.backoff_until)
+            for h in healthy
+        )
+
+    def _fleet_shed(self, rec: FleetRecord, reason: str) -> bool:
+        from .. import telemetry as _tel
+
+        retry = min(
+            (
+                float(h.feed.get("retry_after_s") or 0.05)
+                for h in self.replicas.values()
+                if h.feed is not None
+            ),
+            default=0.05,
+        )
+        self.ledger.resolve(
+            rec, "shed",
+            {"status": "shed", "tokens": [], "reason": reason, "retry_after_s": retry},
+            None, self._now(),
+        )
+        _tel.count("fleet_shed_total")
+        _tel.record_event("fleet_shed", rid=rec.req.rid, reason=reason)
+        return False
+
+    # ----------------------------------------------------------- failover
+    def _failover_replica(self, replica_id: str) -> None:
+        """Re-drive every request in-flight on a dead/removed replica from
+        the prompt on a healthy peer — the tokens replay bit-identically,
+        and the fleet record counts the failover."""
+        for rec in self.ledger.pending():
+            if replica_id in rec.live_on:
+                rec.live_on.remove(replica_id)
+                if not rec.live_on:  # no hedge copy still running elsewhere
+                    self._dispatch(rec, exclude=[replica_id], kind="failover")
+
+    # -------------------------------------------------------------- pump
+    def pump(self) -> int:
+        """One router turn: poll due feeds, harvest terminal outcomes from
+        replicas that hold in-flight work, enforce fleet deadlines, place
+        hedges.  Returns the number of requests still pending."""
+        from .. import telemetry as _tel
+
+        self.poll()
+        now = self._now()
+        # ---- harvest outcomes from every replica holding live work
+        live_by_replica: Dict[str, List[FleetRecord]] = {}
+        for rec in self.ledger.pending():
+            for rid in rec.live_on:
+                live_by_replica.setdefault(rid, []).append(rec)
+        for replica_id, recs in live_by_replica.items():
+            h = self.replicas.get(replica_id)
+            if h is None or not h.breaker.dispatchable:
+                continue
+            try:
+                outs = h.client.outcomes().get("outcomes", {})
+            except ReplicaUnreachable:
+                self._record_failure(h, "outcomes")
+                continue
+            for rec in recs:
+                out = outs.get(str(rec.req.rid))
+                if out is None or out.get("status") not in TERMINAL:
+                    continue
+                # tag gate: a row echoing a different dispatch token is a
+                # STALE terminal from a prior dispatch of this rid to this
+                # replica (the new submission is still in its inbox) —
+                # consuming it would shed/redispatch a request the replica
+                # is about to serve.  Tagless rows (pre-tag replicas) pass.
+                out_tag = out.get("tag")
+                expected = rec.tag_by_replica.get(h.id)
+                if (
+                    out_tag is not None
+                    and expected is not None
+                    and int(out_tag) != expected
+                ):
+                    continue
+                self._on_outcome(rec, h, out)
+        # ---- fleet deadline enforcement (bounds failover loops too)
+        for rec in self.ledger.pending():
+            if self._remaining(rec) <= 0:
+                self.ledger.resolve(
+                    rec, "timed_out",
+                    {"status": "timed_out", "tokens": [], "reason": "fleet deadline"},
+                    None, now,
+                )
+                _tel.count("fleet_timeout_total")
+        # ---- hedging: a request stuck past the bound gets a second copy
+        if self.hedge_s:
+            for rec in self.ledger.pending():
+                if (
+                    not rec.hedged
+                    and rec.live_on
+                    and now - rec.last_dispatch_at > self.hedge_s
+                    and self.pick(session=rec.session, exclude=rec.live_on) is not None
+                ):
+                    self._dispatch(
+                        rec, exclude=list(rec.live_on), kind="hedge", allow_shed=False
+                    )
+        pending = self.ledger.pending_count()
+        _tel.set_gauge("fleet_pending_requests", pending)
+        return pending
+
+    def _on_outcome(self, rec: FleetRecord, h: _Replica, out: Dict[str, Any]) -> None:
+        status = out["status"]
+        if status == "completed" or status == "timed_out":
+            # timed_out is the request's OWN deadline expiring on-replica:
+            # resubmitting would break deadline semantics — it is final
+            self.ledger.resolve(rec, status, out, h.id, self._now())
+        elif status == "shed":
+            # replica-level backpressure: honor the hint, spill elsewhere
+            self._backoff_replica(h, out.get("retry_after_s"))
+            if h.id in rec.live_on:
+                rec.live_on.remove(h.id)
+            if not rec.live_on:
+                if self._all_healthy_shedding():
+                    self._fleet_shed(rec, "every healthy replica shedding")
+                else:
+                    self._dispatch(rec, exclude=[h.id], kind="redispatch")
+        elif status == "preempted_requeue":
+            # the replica is draining: it finished what it could, queued
+            # work comes back re-queueable — re-drive it on a peer
+            if h.id in rec.live_on:
+                rec.live_on.remove(h.id)
+            if not rec.live_on:
+                self._dispatch(rec, exclude=[h.id], kind="redispatch")
+
+    # -------------------------------------------------------------- drive
+    def drain(
+        self, timeout_s: float = 120.0, poll_slice_s: Optional[float] = None
+    ) -> None:
+        """Pump until every submitted request is terminal (the smoke /
+        bench driver).  Raises TimeoutError with the stuck rids if the
+        fleet cannot settle inside ``timeout_s``."""
+        deadline = self._now() + timeout_s
+        slice_s = poll_slice_s if poll_slice_s is not None else self.poll_interval_s
+        while True:
+            if self.pump() == 0:
+                return
+            if self._now() > deadline:
+                raise TimeoutError(
+                    "fleet drain timed out with pending rids "
+                    f"{[r.req.rid for r in self.ledger.pending()]}"
+                )
+            self._sleep(slice_s)
+
+    # ---------------------------------------------------------- reporting
+    def fleet_ledger_check(self) -> None:
+        self.ledger.check()
+
+    def summary(self) -> Dict[str, Any]:
+        """Aggregate fleet stats for the bench rung / smoke print."""
+        per_replica = {
+            h.id: {
+                "breaker": h.breaker.state,
+                "dispatches": h.dispatches,
+                "opens": h.breaker.opens,
+                "reopens": h.breaker.reopens,
+                "closes": h.breaker.closes,
+            }
+            for h in self.replicas.values()
+        }
+        return {"counts": dict(self.ledger.counts), "replicas": per_replica}

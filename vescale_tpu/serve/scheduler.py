@@ -53,13 +53,18 @@ class Request:
     submission step (deterministic — the multi-host rig's unit); a wall
     deadline can ride on top via the loop's ``VESCALE_SERVE_DEADLINE_S``.
     ``eos_id`` stops generation early; ``max_new_tokens`` always bounds
-    it."""
+    it.  ``tag`` is an OPAQUE client token echoed verbatim into this
+    request's terminal outcome row — the fleet router stamps each
+    dispatch attempt with one so a stale ledger row from a prior
+    dispatch of the same rid can never be mistaken for the current
+    attempt's result (serve/router.py)."""
 
     rid: int
     prompt: Tuple[int, ...]
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
     deadline_steps: Optional[int] = None
+    tag: Optional[int] = None
 
     def __post_init__(self):
         if not self.prompt:
@@ -199,6 +204,20 @@ class ContinuousBatchingScheduler:
             p50 = self._step_time_seed or 0.01
         return max(0.01, (len(self.queue) + 1) * max(p50, 1e-4))
 
+    def currently_shedding(self) -> Optional[str]:
+        """The admission-control reason a new submission would be shed
+        RIGHT NOW (bounded queue / p99-TTFT SLO breach), or None.  The
+        ops endpoints publish it — ``accepting`` in the `/router` v2 feed
+        and the ``Retry-After`` header — so a fleet router can spill load
+        to a peer replica without paying a rejected round trip."""
+        if len(self.queue) >= self.max_queue:
+            return f"queue full ({len(self.queue)}/{self.max_queue})"
+        if self.slo_ttft_s > 0:
+            p99 = self.ttft_p99()
+            if p99 is not None and p99 > self.slo_ttft_s:
+                return f"p99 TTFT {p99:.3f}s over SLO {self.slo_ttft_s:g}s"
+        return None
+
     # ----------------------------------------------------------- admission
     def submit(self, req: Request, step: int, raise_on_shed: bool = False) -> bool:
         """Enqueue a request at ``step``; returns False (and records the
@@ -221,13 +240,7 @@ class ContinuousBatchingScheduler:
             self._fold(17, req.rid, step)
         self.counts["submitted"] += 1
         reqtrace.submit(req.rid, step)
-        reason = None
-        if len(self.queue) >= self.max_queue:
-            reason = f"queue full ({len(self.queue)}/{self.max_queue})"
-        elif self.slo_ttft_s > 0:
-            p99 = self.ttft_p99()
-            if p99 is not None and p99 > self.slo_ttft_s:
-                reason = f"p99 TTFT {p99:.3f}s over SLO {self.slo_ttft_s:g}s"
+        reason = self.currently_shedding()
         total = len(req.prompt) + req.max_new_tokens
         if reason is None and total > self.cache.max_seq_len:
             reason = (
@@ -249,6 +262,7 @@ class ContinuousBatchingScheduler:
                 "reason": reason,
                 "retry_after_s": retry,
                 "tokens": [],
+                "tag": req.tag,
             }
             _tel.count("serve_requests_shed_total")
             _tel.count("resilience_shed_total")
@@ -300,6 +314,7 @@ class ContinuousBatchingScheduler:
             "status": status,
             "tokens": list(inf.tokens),
             "replays": inf.replays,
+            "tag": inf.req.tag,  # the request's opaque token, echoed
             **extra,
         }
 
@@ -358,6 +373,7 @@ class ContinuousBatchingScheduler:
                     "tokens": [],
                     "replays": self._queued_replays(req.rid),
                     "reason": "queued past deadline",
+                    "tag": req.tag,
                 }
                 reqtrace.terminal(req.rid, "timed_out", 0,
                                   reason="queued past deadline")
@@ -432,6 +448,7 @@ class ContinuousBatchingScheduler:
                 "replays": self._queued_replays(req.rid),
                 "reason": reason,
                 "retry_after_s": self.retry_after_s(),
+                "tag": req.tag,
             }
             reqtrace.terminal(req.rid, "preempted_requeue", 0, reason=reason)
             self.counts["shed"] += 1

@@ -160,6 +160,10 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     # serving block: the continuous-batching loop's request ledger +
     # in-flight/queue gauges (serve/scheduler.py + serve/loop.py feed)
     _sv = ("serve_",)
+    # fleet block: the multi-replica router's dispatch/failover/breaker
+    # totals + healthy-replica and pending gauges (serve/router.py +
+    # serve/fleet.py feed)
+    _fl = ("fleet_",)
     # pallas kernel layer: dispatch/fallback decision totals per kernel
     # (kernels/__init__.py feed, riding the same registry gate)
     _kn = ("kernel_",)
@@ -170,10 +174,12 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     tr_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_tr)}
     cp_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_cp)}
     sv_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_sv)}
+    fl_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_fl)}
+    fl_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_fl)}
     other_gauges = {
         n: v
         for n, v in snap["gauges"].items()
-        if not n.startswith(("mem_",) + _res + _qc + _tr + _cp + _sv + _kn)
+        if not n.startswith(("mem_",) + _res + _qc + _tr + _cp + _sv + _kn + _fl)
     }
     res_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_res)}
     qc_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_qc)}
@@ -183,7 +189,7 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     other_counters = {
         n: v
         for n, v in snap["counters"].items()
-        if not n.startswith(_res + _qc + _tr + _cp + _sv + _kn)
+        if not n.startswith(_res + _qc + _tr + _cp + _sv + _kn + _fl)
     }
     if other_counters:
         lines.append("counters:")
@@ -234,6 +240,14 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
             lines.append(f"  {name:<48} {_fmt(sv_counters[name]):>12}")
         for name in sorted(sv_gauges):
             lines.append(f"  {name:<48} {sv_gauges[name]:>12.6g}")
+    if fl_counters or fl_gauges:
+        # fleet-router block: dispatch/redispatch/failover/hedge/shed
+        # totals, breaker transitions, healthy-replica + pending gauges
+        lines.append("fleet:")
+        for name in sorted(fl_counters):
+            lines.append(f"  {name:<48} {_fmt(fl_counters[name]):>12}")
+        for name in sorted(fl_gauges):
+            lines.append(f"  {name:<48} {fl_gauges[name]:>12.6g}")
     if res_counters or res_gauges:
         # recovery-event block (resilience/loop.py feed, mirrors memory:):
         # a zero-fault run shows armed-but-quiet counters at 0

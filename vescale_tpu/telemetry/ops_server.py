@@ -2,7 +2,7 @@
 
 The serve loop is observable after the fact (steps.jsonl, Perfetto
 traces), but a fleet dispatcher and a liveness probe need answers DURING
-the run.  This module serves three read-only endpoints from one
+the run.  This module serves the replica's network surface from one
 ``ThreadingHTTPServer`` daemon thread:
 
   ``/metrics``   Prometheus text exposition of the live registry (the
@@ -13,9 +13,32 @@ the run.  This module serves three read-only endpoints from one
                  age, queue depth, free slots/pages, drain state).
   ``/router``    the replica's dispatch feed (serve/obs.py: queue depth,
                  TTFT/ITL percentiles, shed rate, capacity, goodput) — the
-                 JSON a multi-replica router polls to place requests.  The
-                 schema is FROZEN (docs/serving.md): routers are written
-                 against it, so fields are only ever added.
+                 JSON the fleet router (serve/router.py) polls to place
+                 requests.  The schema is FROZEN (docs/serving.md):
+                 routers are written against it, so fields are only ever
+                 added (v2 added ``replica_id`` and ``accepting``).
+  ``/outcomes``  the replica's terminal-outcome ledger snapshot
+                 (serve/fleet.py registers it) — how the fleet router
+                 learns completions without a push channel.
+  ``/submit``    POST: one request into the replica's inbox
+                 (serve/fleet.py) — the fleet router's dispatch hop.
+
+Hardening (the fleet front-end depends on it):
+
+  * **Atomic replies.**  Status line, headers and body are assembled into
+    ONE buffer and written with a single ``wfile.write`` — a poller
+    racing a server shutdown sees either a complete response or a closed
+    connection, never a half-written body (regression-tested by a
+    concurrent poller hammering ``/router`` across restarts).
+  * **Retry-After.**  ``/healthz`` and ``/router`` replies carry a
+    ``Retry-After`` header whenever the replica is draining or its
+    admission control is currently shedding, so even header-only HTTP
+    clients get the backpressure hint (the value is the same
+    ``retry_after_s`` the JSON carries, rounded up to whole seconds).
+  * **poll_blackhole.**  The faultsim kind of the same name makes a due
+    ``/router``/``/healthz`` GET close the connection without writing a
+    byte — a deterministic network partition for the fleet router's
+    breaker tests (disarmed, the hook is the usual no-op reference).
 
 Gating matches the telemetry convention: the port knob
 ``VESCALE_SERVE_OPS_PORT`` is OFF by default — :func:`maybe_start`
@@ -30,6 +53,7 @@ sidecar), not something a library should default to.
 from __future__ import annotations
 
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
@@ -41,10 +65,23 @@ Provider = Callable[[], Dict]
 _ACTIVE: Optional["OpsServer"] = None
 _LOCK = threading.Lock()
 
+# GET endpoints a provider may be registered for; /submit is the one POST
+_GET_ENDPOINTS = ("healthz", "router", "outcomes")
+_POST_ENDPOINTS = ("submit",)
+
+_STATUS_TEXT = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
 
 class _Handler(BaseHTTPRequestHandler):
     # the server instance injects itself as .ops on the handler class
-    server_version = "vescale-ops/1"
+    server_version = "vescale-ops/2"
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # no per-request stderr spam
         pass
@@ -52,13 +89,43 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (stdlib naming)
         ops: "OpsServer" = self.server.ops  # type: ignore[attr-defined]
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path in ("/healthz", "/router"):
+            # injected network partition: the poller's GET dies without a
+            # byte on the wire (breaker fodder; no-op ref while disarmed)
+            from ..resilience import faultsim as _fs
+
+            if _fs.fires("poll_blackhole", ctx=path):
+                self.close_connection = True
+                return
         if path == "/metrics":
             self._metrics()
-        elif path in ("/healthz", "/router"):
+        elif path.lstrip("/") in _GET_ENDPOINTS:
             self._json(ops.providers.get(path.lstrip("/")))
         else:
             self._send(404, "text/plain; charset=utf-8",
-                       "not found (endpoints: /metrics /healthz /router)\n")
+                       "not found (endpoints: /metrics /healthz /router "
+                       "/outcomes /submit)\n")
+
+    def do_POST(self):  # noqa: N802 (stdlib naming)
+        ops: "OpsServer" = self.server.ops  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0].rstrip("/")
+        provider = ops.providers.get(path.lstrip("/"))
+        if path.lstrip("/") not in _POST_ENDPOINTS or provider is None:
+            self._send(404, "text/plain; charset=utf-8",
+                       "no POST provider registered for this endpoint\n")
+            return
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(n).decode() or "{}")
+        except (ValueError, UnicodeDecodeError) as e:
+            self._send(400, "text/plain; charset=utf-8", f"bad request body: {e}\n")
+            return
+        try:
+            body = json.dumps(provider(payload), sort_keys=True)
+        except Exception as e:  # the submitter must see the failure
+            self._send(500, "text/plain; charset=utf-8", f"provider error: {e}\n")
+            return
+        self._send(200, "application/json", body + "\n")
 
     # ------------------------------------------------------------ bodies
     def _metrics(self) -> None:
@@ -79,19 +146,52 @@ class _Handler(BaseHTTPRequestHandler):
                        "no provider registered for this endpoint\n")
             return
         try:
-            body = json.dumps(provider(), sort_keys=True)
+            payload = provider()
+            body = json.dumps(payload, sort_keys=True)
         except Exception as e:  # a probe must see the failure, not a hang
             self._send(500, "text/plain; charset=utf-8", f"provider error: {e}\n")
             return
-        self._send(200, "application/json", body + "\n")
+        self._send(200, "application/json", body + "\n",
+                   extra_headers=_retry_after_headers(payload))
 
-    def _send(self, code: int, ctype: str, body: str) -> None:
+    def _send(self, code: int, ctype: str, body: str,
+              extra_headers: Optional[Dict[str, str]] = None) -> None:
+        """One-buffer response write: the whole reply (status line,
+        headers, body) leaves in a single ``write`` so a concurrent
+        shutdown can never strand a poller mid-body."""
         data = body.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+        head_lines = [
+            f"HTTP/1.1 {code} {_STATUS_TEXT.get(code, 'Unknown')}",
+            f"Server: {self.server_version}",
+            f"Content-Type: {ctype}",
+            f"Content-Length: {len(data)}",
+        ]
+        for k, v in (extra_headers or {}).items():
+            head_lines.append(f"{k}: {v}")
+        head_lines.append("Connection: close")
+        head = ("\r\n".join(head_lines) + "\r\n\r\n").encode("latin-1")
+        try:
+            self.wfile.write(head + data)
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # the poller hung up first; nothing to salvage
+        self.close_connection = True
+
+
+def _retry_after_headers(payload) -> Optional[Dict[str, str]]:
+    """The backpressure header contract: a draining or shedding replica's
+    `/healthz` and `/router` replies say so in the HTTP layer too."""
+    if not isinstance(payload, dict):
+        return None
+    draining = bool(payload.get("draining"))
+    shedding = bool(payload.get("shedding")) or payload.get("accepting") is False
+    if not (draining or shedding):
+        return None
+    try:
+        retry = float(payload.get("retry_after_s") or 1.0)
+    except (TypeError, ValueError):
+        retry = 1.0
+    return {"Retry-After": str(max(1, math.ceil(retry)))}
 
 
 class OpsServer:
@@ -100,13 +200,14 @@ class OpsServer:
         srv = OpsServer(port=0).start()          # 0 = OS-assigned
         srv.register("healthz", health_fn)       # fn() -> JSON-able dict
         srv.register("router", router_fn)
+        srv.register("submit", submit_fn)        # fn(payload) -> dict
         ... GET http://127.0.0.1:{srv.port}/healthz ...
         srv.stop()
     """
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         self.host = host
-        self.providers: Dict[str, Provider] = {}
+        self.providers: Dict[str, Callable] = {}
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.ops = self  # type: ignore[attr-defined]
@@ -120,8 +221,8 @@ class OpsServer:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
-    def register(self, endpoint: str, provider: Provider) -> "OpsServer":
-        if endpoint not in ("healthz", "router"):
+    def register(self, endpoint: str, provider: Callable) -> "OpsServer":
+        if endpoint not in _GET_ENDPOINTS + _POST_ENDPOINTS:
             raise ValueError(f"unknown ops endpoint {endpoint!r}")
         self.providers[endpoint] = provider
         return self
@@ -157,12 +258,15 @@ def maybe_start(
     health: Optional[Provider] = None,
     router: Optional[Provider] = None,
     port: Optional[int] = None,
+    extra: Optional[Dict[str, Callable]] = None,
 ) -> Optional[OpsServer]:
     """The serve loop's gate: start an :class:`OpsServer` when
     ``VESCALE_SERVE_OPS_PORT`` is set (``port`` overrides), else do
-    NOTHING — no socket, no thread, return ``None``.  The started server
-    is registered as the process's :func:`active_server` so pollers
-    launched elsewhere (tests, smoke scripts) can find the bound port."""
+    NOTHING — no socket, no thread, return ``None``.  ``extra`` maps
+    additional endpoint names (``outcomes``, ``submit``) to providers.
+    The started server is registered as the process's
+    :func:`active_server` so pollers launched elsewhere (tests, smoke
+    scripts) can find the bound port."""
     global _ACTIVE
     if port is None:
         from ..analysis import envreg
@@ -175,6 +279,8 @@ def maybe_start(
         srv.register("healthz", health)
     if router is not None:
         srv.register("router", router)
+    for name, provider in (extra or {}).items():
+        srv.register(name, provider)
     srv.start()
     with _LOCK:
         _ACTIVE = srv
