@@ -1345,8 +1345,9 @@ def _dispatch():
         # multi-replica fleet rung (VESCALE_BENCH=fleet): aggregate
         # tokens/s, fleet p99 TTFT and shed rate under a 5x-capacity
         # overload with a mid-run replica kill + rejoin, plus the
-        # router-hop-vs-direct-submit overhead line (<1% bar) —
-        # scripts/fleet_smoke.py emits the line
+        # router-hop-vs-direct-submit overhead line AND the tracing-on
+        # vs tracing-off hop line (fleet_trace_overhead_frac, both <1%
+        # bar) — scripts/fleet_smoke.py emits the line
         sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"))
         import fleet_smoke
 

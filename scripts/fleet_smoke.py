@@ -29,7 +29,9 @@ same prompt — decode determinism at fleet scope):
 5x-capacity overload with a mid-run kill + rejoin — aggregate tokens/s,
 fleet p99 TTFT, shed rate — plus the router-hop overhead line (router
 dispatch vs direct submit, as a fraction of a measured decode step,
-acceptance < 1%).
+acceptance < 1%) and the tracing-on vs tracing-off hop line
+(``fleet_trace_overhead_frac``: what the ISSUE-14 fleet span chain adds
+per request over the same service-time denominator, same < 1% bar).
 
 Exit 0 on success.  Wired into scripts/run_test.sh and tier-1 via
 tests/test_fleet.py.
@@ -433,22 +435,57 @@ def run_bench() -> dict:
                 return {"outcomes": {}}
 
         hop_iters = 2000
-        lb = FleetRouter(poll_interval_s=3600.0, breaker_failures=3,
-                         breaker_cooldown_s=1.0, dispatch_retries=1,
-                         backoff_s=0.0, backoff_max_s=0.0, hedge_s=0.0)
-        lb.add_replica("L", _InstantClient())
-        lb.poll(force=True)
-        t0 = time.perf_counter()
-        for i in range(hop_iters):
-            lb.submit(Request(rid=i, prompt=(1, 2), max_new_tokens=1))
-        hop_s = (time.perf_counter() - t0) / hop_iters
+        hop_reps = 5  # min-of-reps: the noise-robust estimator — on a
+        # contended CPU single-run jitter swamps the few-us tracing delta
 
-        inbox = RequestInbox()
-        t0 = time.perf_counter()
-        for i in range(hop_iters):
-            inbox.push(Request(rid=i, prompt=(1, 2), max_new_tokens=1))
-        direct_s = (time.perf_counter() - t0) / hop_iters
+        def _hop_min():
+            best = float("inf")
+            for _ in range(hop_reps):
+                r = FleetRouter(poll_interval_s=3600.0, breaker_failures=3,
+                                breaker_cooldown_s=1.0, dispatch_retries=1,
+                                backoff_s=0.0, backoff_max_s=0.0, hedge_s=0.0)
+                r.add_replica("L", _InstantClient())
+                r.poll(force=True)
+                for i in range(300):  # warm before every timed window
+                    r.submit(Request(rid=1_000_000 + i, prompt=(1, 2),
+                                     max_new_tokens=1))
+                t0 = time.perf_counter()
+                for i in range(hop_iters):
+                    r.submit(Request(rid=i, prompt=(1, 2), max_new_tokens=1))
+                best = min(best, (time.perf_counter() - t0) / hop_iters)
+            return best
+
+        hop_s = _hop_min()
+
+        direct_s = float("inf")
+        for _ in range(hop_reps):
+            inbox = RequestInbox()
+            t0 = time.perf_counter()
+            for i in range(hop_iters):
+                inbox.push(Request(rid=i, prompt=(1, 2), max_new_tokens=1))
+            direct_s = min(direct_s, (time.perf_counter() - t0) / hop_iters)
         hop_overhead = max(0.0, hop_s - direct_s)
+
+        # ---- tracing-on vs tracing-off hop (ISSUE 14 satellite): the
+        # same router hop with the ndtimeline profiler LIVE, so every
+        # submit emits its fleet-submit/dispatch-attempt/fleet-terminal
+        # chain — the added cost, amortized over a request's decode
+        # service time exactly like the hop itself, must stay < 1%
+        from vescale_tpu.ndtimeline import api as nd_api
+
+        # own-the-profiler guard: a caller that already runs ndtimeline
+        # keeps its manager/handlers (and its baseline hop above was
+        # already traced, so the delta honestly reads ~0 there)
+        own_nd = not nd_api.is_active()
+        if own_nd:
+            nd_api.init_ndtimers(rank=0)
+        try:
+            traced_hop_s = _hop_min()
+        finally:
+            if own_nd:
+                nd_api.deinit_ndtimers()
+        trace_added = max(0.0, traced_hop_s - hop_s)
+        service_s = max(1e-9, tokens_per_req * step_p50)
 
         return {
             "metric": "fleet_tokens_per_s_cpu",
@@ -465,14 +502,16 @@ def run_bench() -> dict:
             "ttft_p99_ms": round(ttft_p99 * 1e3, 3),
             "wall_s": round(wall, 2),
             "router_hop_us": round(hop_s * 1e6, 2),
+            "router_hop_traced_us": round(traced_hop_s * 1e6, 2),
             "direct_submit_us": round(direct_s * 1e6, 2),
             "decode_step_p50_ms": round(step_p50 * 1e3, 3),
             # ONE router hop per request, amortized over the request's
             # decode service time (tokens/request x measured ITL p50) —
             # the fraction the router adds to serving a request
-            "router_hop_overhead_frac": round(
-                hop_overhead / max(1e-9, tokens_per_req * step_p50), 5
-            ),
+            "router_hop_overhead_frac": round(hop_overhead / service_s, 5),
+            # tracing-on minus tracing-off hop over the same denominator:
+            # what the fleet-trace span chain adds per request
+            "fleet_trace_overhead_frac": round(trace_added / service_s, 5),
             "acceptance_lt": 0.01,
         }
     finally:

@@ -1,0 +1,224 @@
+"""Fleet-tracing smoke — the acceptance run of ISSUE 14.
+
+One 3-replica fleet (the PR-13 kill+rejoin battery: replica r1 armed with
+``replica_kill`` dies abruptly mid-load via os._exit, the supervisor
+respawns it, the router's breaker walks closed -> open -> half-open ->
+closed, stranded requests fail over) runs with the FULL fleet tracing
+layer armed:
+
+  * every replica persists its span stream per boundary
+    (``VESCALE_FLEET_TRACE_DIR`` -> ``<dir>/<rid>.spans.jsonl``) — so even
+    the KILLED replica's pre-death spans survive on disk;
+  * the router (this driver) records its own journey chain per request
+    (fleet-submit -> dispatch-attempt[i] -> fleet-terminal, breaker
+    transitions as spans) through the same ndtimeline ring;
+  * per-replica clock offsets are estimated over HTTP
+    (``fleettrace.estimate_fleet_clock_offsets`` — the
+    ``estimate_clock_offsets`` round structure on the ops endpoints).
+
+After the drain the driver assembles ONE fleet timeline
+(``assemble_fleet_timeline``: replica-qualified pid lanes, clock-aligned,
+cross-process flow arrows router->replica stitched by the dispatch tag),
+writes it as Perfetto JSON, loads it BACK, and asserts over the
+round-tripped spans:
+
+  * ``verify_fleet_journeys`` passes against the balanced FleetLedger —
+    every rid maps to exactly ONE journey with exactly ``failovers + 1``
+    dispatch sub-chains, zero orphan, zero duplicate journeys, and every
+    completed journey's winning dispatch tag is stitched to a replica
+    serve-submit span;
+  * at least one failover journey renders as ONE stitched journey: router
+    spans + BOTH replicas' spans under the same rid, with ``disp<tag>``
+    flow arrows crossing process lanes in the written JSON;
+  * per-replica chain verification passes with the stranded/superseded
+    chains classified ``superseded-by-failover`` (the satellite fix) —
+    including the killed replica's pre-death chains;
+  * breaker transition spans for the killed replica appear in order
+    (closed -> open, open -> half_open, half_open -> closed).
+
+Exit 0 on success.  Wired into scripts/run_test.sh and tier-1 via
+tests/test_fleettrace.py.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    sys.path.insert(0, REPO)
+    import fleet_smoke
+
+    from vescale_tpu.ndtimeline import api as nd_api
+    from vescale_tpu.ndtimeline.parser_handler import parse_raw_spans
+    from vescale_tpu.ndtimeline import predefined as P
+    from vescale_tpu.serve import FleetSupervisor, fleettrace
+    from vescale_tpu.serve.reqtrace import classify_chains, verify_request_chains
+    from vescale_tpu.telemetry.trace import (
+        load_perfetto,
+        spans_from_perfetto,
+        write_perfetto,
+    )
+
+    work = tempfile.mkdtemp(prefix="fleet_trace_smoke_")
+    trace_dir = os.path.join(work, "trace")
+    os.makedirs(trace_dir, exist_ok=True)
+    t0 = time.monotonic()
+    mgr = nd_api.init_ndtimers(rank=0)  # the ROUTER's span ring
+    try:
+        specs = fleet_smoke._specs(
+            work, fleet_smoke.N_REPLICAS, kill_replica="r1",
+            extra_env={"VESCALE_FLEET_TRACE_DIR": trace_dir},
+        )
+        fr, Client = fleet_smoke._router()
+        sup = FleetSupervisor(specs, max_restarts=2, restart_backoff_s=0.3)
+        sup.start()
+        try:
+            for s in specs:
+                fr.add_replica(s.replica_id, Client(s.url))
+            fleet_smoke._wait_fleet_up(fr, sup, specs)
+            fleet_smoke._submit_wave(fr, fleet_smoke._prompts(fleet_smoke.WAVE1))
+            fleet_smoke._drain(fr, sup)
+
+            # rejoin: wait for r1's half-open probe to readmit it, then
+            # prove fresh traffic traces through the restarted replica
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                sup.poll()
+                fr.poll(force=True)
+                if fr.replicas["r1"].breaker.state == "closed":
+                    break
+                time.sleep(0.2)
+            assert fr.replicas["r1"].breaker.state == "closed", (
+                f"r1 never readmitted: {fr.replicas['r1'].breaker.state}"
+            )
+            fleet_smoke._submit_wave(
+                fr, fleet_smoke._prompts(fleet_smoke.WAVE2, base_rid=100),
+                use_session=False,
+            )
+            fleet_smoke._drain(fr, sup)
+
+            # HTTP clock sync while every replica is still answering
+            clock = fleettrace.estimate_fleet_clock_offsets(
+                {rid: h.client for rid, h in fr.replicas.items()}
+            )
+            assert set(clock.offsets_us) == {"r0", "r1", "r2"}, clock.offsets_us
+            assert all(v >= 0 for v in clock.residual_us.values()), (
+                "a replica answered clock-sync rounds without wall_time_us: "
+                f"{clock.residual_us}"
+            )
+            fr.fleet_ledger_check()
+        finally:
+            rcs = sup.stop_all(grace_s=30.0)
+            print(f"replica exits {rcs}")
+
+        failover_recs = [r for r in fr.ledger.records.values() if r.failovers >= 1]
+        assert failover_recs, "kill leg produced no failover"
+
+        # ---- assemble: router ring + the three on-disk replica streams
+        streams = {"router": mgr.flush()}
+        for rid in ("r0", "r1", "r2"):
+            path = os.path.join(trace_dir, f"{rid}.spans.jsonl")
+            assert os.path.exists(path), f"{rid} persisted no span stream"
+            streams[rid] = parse_raw_spans(path)
+            assert streams[rid], f"{rid} span stream is empty"
+        merged = fleettrace.assemble_fleet_timeline(streams, clock=clock)
+        trace_path = os.path.join(work, "fleet_trace.json")
+        write_perfetto(merged, trace_path,
+                       process_names=fleettrace.fleet_process_names(streams))
+
+        # ---- every journey verified over the ROUND-TRIPPED trace
+        reloaded = spans_from_perfetto(trace_path)
+        problems = fleettrace.verify_fleet_journeys(
+            reloaded, fr.ledger, require_stitch=True
+        )
+        assert not problems, f"fleet journeys: {problems}"
+
+        # ---- a replica_kill failover renders as ONE stitched journey:
+        # router spans + BOTH replicas' spans under the same rid
+        def rid_streams(rid):
+            return {
+                s.tags.get("stream") for s in reloaded
+                if s.tags and s.tags.get("rid") == rid
+                and s.metric not in fleettrace.FLEET_SPAN_METRICS
+            }
+
+        stitched = [
+            rec for rec in failover_recs
+            if len(rid_streams(rec.req.rid)) >= 2
+        ]
+        assert stitched, (
+            "no failover rid carries spans from BOTH replicas: "
+            f"{[(r.req.rid, sorted(rid_streams(r.req.rid))) for r in failover_recs]}"
+        )
+
+        # ---- disp<tag> flow arrows cross process lanes in the JSON
+        events = load_perfetto(trace_path)["traceEvents"]
+        flow_pids = {}
+        for e in events:
+            if e.get("ph") in ("s", "f") and str(e.get("id", "")).startswith("disp"):
+                flow_pids.setdefault(e["id"], set()).add(e["pid"])
+        crossing = [fid for fid, pids in flow_pids.items() if len(pids) >= 2]
+        assert crossing, f"no cross-process dispatch flow arrows: {flow_pids}"
+        win = stitched[0]
+        win_tag = win.tag_by_replica[win.replica]
+        assert f"disp{win_tag}" in flow_pids, (
+            f"winning dispatch tag {win_tag} of failover rid {win.req.rid} "
+            "drew no flow arrow"
+        )
+
+        # ---- per-replica chains: stranded chains classify as
+        # superseded-by-failover instead of failing as orphans
+        superseded_seen = 0
+        for rid in ("r0", "r1", "r2"):
+            outcomes = {
+                rec.req.rid: rec.outcome
+                for rec in fr.ledger.records.values()
+                if rec.replica == rid and rec.outcome is not None
+            }
+            sup_rids = fleettrace.superseded_rids(fr.ledger, rid)
+            probs = verify_request_chains(streams[rid], outcomes, superseded=sup_rids)
+            assert not probs, f"{rid} chains: {probs}"
+            cls = classify_chains(streams[rid], outcomes, superseded=sup_rids)
+            superseded_seen += sum(
+                1 for v in cls.values() if v == "superseded-by-failover"
+            )
+            assert "orphan" not in cls.values(), (rid, cls)
+        assert superseded_seen >= 1, (
+            "the kill stranded no chain — superseded-by-failover never exercised"
+        )
+
+        # ---- breaker transitions: the kill's walk is visible in order
+        walks = [
+            (s.tags["from"], s.tags["to"])
+            for s in reloaded
+            if s.metric == P.FLEET_BREAKER and s.tags
+            and s.tags.get("replica") == "r1"
+        ]
+        assert ("closed", "open") in walks, walks
+        assert ("open", "half_open") in walks, walks
+        assert ("half_open", "closed") in walks, walks
+        assert walks.index(("closed", "open")) < walks.index(("open", "half_open")), walks
+
+        c = fr.summary()["counts"]
+        print(
+            "FLEET TRACE SMOKE OK: replica killed mid-load -> "
+            f"{c['failovers']} failover(s) rendered as stitched journeys "
+            f"({len(merged)} spans, {len(crossing)} cross-process arrows, "
+            f"max clock residual {clock.max_residual_us():.0f}us), "
+            f"{superseded_seen} stranded chain(s) classified "
+            "superseded-by-failover, fleet journeys verified against a "
+            f"balanced ledger ({time.monotonic() - t0:.1f}s)"
+        )
+    finally:
+        nd_api.deinit_ndtimers()
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

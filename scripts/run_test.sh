@@ -22,6 +22,8 @@ echo "=== serve observability smoke (request span chains ledger-matched, live op
 python scripts/serve_obs_smoke.py || failed=1
 echo "=== fleet smoke (multi-replica router: kill mid-load -> failover -> rejoin, ledger balanced)"
 python scripts/fleet_smoke.py || failed=1
+echo "=== fleet trace smoke (kill+rejoin battery -> ONE stitched fleet timeline, journeys verified)"
+python scripts/fleet_trace_smoke.py || failed=1
 for f in tests/test_*.py; do
   echo "=== $f"
   python -m pytest "$f" -q || failed=1

@@ -330,6 +330,12 @@ register("VESCALE_FLEET_BACKOFF_MAX_S", "float", 2.0,
          "Fleet dispatch backoff ceiling in seconds.")
 register("VESCALE_FLEET_HEDGE_S", "float", 0.0,
          "Tail-latency hedge bound in seconds: a request unresolved this long after dispatch is sent to a SECOND replica (first terminal outcome wins — decode determinism keeps the answers identical); 0 disables hedging.")
+register("VESCALE_FLEET_TRACE_DIR", "str", None,
+         "Directory where fleet-traced serve replicas persist their ndtimeline span streams (`<dir>/<replica_id>.spans.jsonl`, flushed per boundary) for the fleet timeline assembler; unset disables replica-side trace persistence (docs/observability.md fleet tracing).")
+register("VESCALE_FLEET_TRACE_FLUSH_EVERY", "int", 1,
+         "Boundary cadence at which a fleet-traced replica flushes its span ring to the trace stream (1 = every boundary; higher trades crash-durability of the newest spans for fewer writes).")
+register("VESCALE_FLEET_OPS_PORT", "int", None,
+         "Localhost port for the fleet ROUTER's own ops endpoints (`/fleet` aggregate rollup, `/healthz`, router-process `/metrics`): unset = off (no socket, no thread), 0 = auto-assign (docs/serving.md).")
 
 # --- trace timeline / cost calibration -------------------------------
 register("VESCALE_COST_CALIBRATION", "str", None,

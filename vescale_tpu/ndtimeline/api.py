@@ -12,6 +12,7 @@ from .world_info import WorldInfo
 
 __all__ = [
     "init_ndtimers",
+    "deinit_ndtimers",
     "flush",
     "wait",
     "inc_step",
@@ -44,6 +45,16 @@ def init_ndtimers(rank: int = 0, mesh=None, handlers=(), max_spans: int = 100_00
     for h in handlers:
         _MANAGER.register_handler(h)
     return _MANAGER
+
+
+def deinit_ndtimers() -> None:
+    """Deactivate the profiler and drop the global manager — the inverse
+    of :func:`init_ndtimers`, for A/B overhead rungs (bench.py measures a
+    traced leg then restores the dormant no-op state) and test teardown.
+    Buffered spans that were never flushed are discarded."""
+    global _MANAGER, _ACTIVE
+    _ACTIVE = False
+    _MANAGER = None
 
 
 def flush(step_range=None, next_iteration: bool = False):

@@ -72,8 +72,19 @@ class ChromeTraceHandler:
                 }
             )
             tags = s.tags or {}
-            role = tags.get("flow_role")
-            if role in ("send", "recv") and "flow_id" in tags:
+            roles = tags.get("flow_role")
+            fids = tags.get("flow_id")
+            if roles is None or fids is None:
+                continue
+            # a span may participate in SEVERAL flows (parallel lists):
+            # e.g. a replica's serve-submit span is the recv end of the
+            # router's dispatch arrow AND the send end of its own
+            # submit->terminal arrow (fleettrace.assemble_fleet_timeline)
+            if not isinstance(roles, (list, tuple)):
+                roles, fids = [roles], [fids]
+            for role, fid in zip(roles, fids):
+                if role not in ("send", "recv"):
+                    continue
                 # flow start anchors at the send span's END, flow finish at
                 # the recv span's START with bp="e" (bind to the enclosing
                 # slice) — the arrow spans exactly the in-flight window
@@ -83,7 +94,7 @@ class ChromeTraceHandler:
                         "cat": self.FLOW_CAT,
                         "ph": "s" if role == "send" else "f",
                         **({"bp": "e"} if role == "recv" else {}),
-                        "id": tags["flow_id"],
+                        "id": fid,
                         "ts": (s.start + s.duration) * 1e6 if role == "send" else s.start * 1e6,
                         "pid": s.rank,
                         "tid": tid,

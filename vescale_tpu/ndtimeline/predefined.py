@@ -34,3 +34,17 @@ SERVE_DECODE_STEP = "serve-decode-step"
 SERVE_DECODE_TOKEN = "serve-decode-token"
 SERVE_EVICT = "serve-evict"
 SERVE_TERMINAL = "serve-terminal"
+# fleet-router request journey (serve/fleettrace.py emits; docs/
+# observability.md "Fleet tracing").  Every routed request's ROUTER-side
+# chain is
+#   fleet-submit -> fleet-dispatch-attempt[i]* (backoff forks between
+#   attempts) -> fleet-terminal
+# with the dispatch-attempt ``tag`` doubling as the trace context that
+# rides the /submit wire: the replica's serve-submit span echoes it, so
+# the fleet timeline assembler stitches router chains to replica chains
+# by construction (fleettrace.assemble_fleet_timeline).
+FLEET_SUBMIT = "fleet-submit"
+FLEET_DISPATCH = "fleet-dispatch-attempt"
+FLEET_BACKOFF = "fleet-backoff"
+FLEET_BREAKER = "fleet-breaker"
+FLEET_TERMINAL = "fleet-terminal"

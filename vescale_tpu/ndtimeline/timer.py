@@ -51,6 +51,15 @@ class NDTimerManager:
     def register_handler(self, handler: Callable[[List[Span]], None]) -> None:
         self._handlers.append(handler)
 
+    def unregister_handler(self, handler: Callable[[List[Span]], None]) -> None:
+        """Remove a previously registered handler (idempotent) — a
+        scoped consumer (the serve loop's fleet-trace stream) must not
+        keep receiving spans after its run ends."""
+        try:
+            self._handlers.remove(handler)
+        except ValueError:
+            pass
+
     def calibrate(self, offset_seconds: float) -> None:
         """Shift timestamps by a global-clock offset (reference calibration
         on flush, ndtimeline/README.md:16-20)."""

@@ -19,12 +19,19 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from . import fleet, obs, reqtrace, router
+from . import fleet, fleettrace, obs, reqtrace, router
 from .engine import ServeEngine
 from .fleet import FleetSupervisor, ReplicaSpec, RequestInbox, serve_replica
+from .fleettrace import (
+    FleetClockSync,
+    assemble_fleet_timeline,
+    estimate_fleet_clock_offsets,
+    superseded_rids,
+    verify_fleet_journeys,
+)
 from .kv_cache import KVCacheConfig, KVCacheOutOfPages, PagedKVCache
 from .loop import ServeResult, run_serve_resilient
-from .obs import ServeObservability
+from .obs import FleetObservability, ServeObservability
 from .router import (
     CircuitBreaker,
     ConsistentHashRing,
@@ -44,6 +51,12 @@ __all__ = [
     "ServeEngine",
     "ServeResult",
     "ServeObservability",
+    "FleetObservability",
+    "FleetClockSync",
+    "assemble_fleet_timeline",
+    "estimate_fleet_clock_offsets",
+    "superseded_rids",
+    "verify_fleet_journeys",
     "run_serve_resilient",
     "load_params",
     "CircuitBreaker",
@@ -59,6 +72,7 @@ __all__ = [
     "reqtrace",
     "router",
     "fleet",
+    "fleettrace",
 ]
 
 

@@ -191,6 +191,44 @@ def run_serve_resilient(
     else:
         ops = _ops.maybe_start(health=obs.health, router=obs.router)
         own_ops = ops is not None
+    # ---- fleet trace persistence (VESCALE_FLEET_TRACE_DIR): this
+    # replica's span stream lands on disk AS THE RUN GOES — flushed every
+    # VESCALE_FLEET_TRACE_FLUSH_EVERY boundaries, so even an abrupt
+    # replica_kill leaves every prior boundary's spans harvestable for
+    # the fleet timeline assembler (fleettrace.assemble_fleet_timeline).
+    # The stream file is keyed by replica_id (rank-qualified on
+    # multi-process replicas so two ranks never interleave one file); a
+    # respawned replica appends to the same file (its stranded prior-life
+    # chains classify as superseded-by-failover at verification).  The
+    # handler is scoped to THIS run (unregistered in the finally), and
+    # flush cadence belongs to whoever owns the profiler: when the loop
+    # initialized it, it drains per boundary for crash durability and
+    # deactivates it on exit; an externally-initialized profiler keeps
+    # its owner's flush discipline (the stream receives whatever the
+    # owner flushes while the loop runs).
+    fleet_trace_every = 0
+    fleet_trace_handler = None
+    own_nd_trace = False
+    fleet_trace_dir = envreg.get_str("VESCALE_FLEET_TRACE_DIR")
+    if fleet_trace_dir:
+        from ..ndtimeline.handlers import LocalRawHandler
+
+        own_nd_trace = not _nd.is_active()
+        if own_nd_trace:
+            _nd.init_ndtimers(rank=jax.process_index())
+        stream = (
+            obs.replica_id
+            if jax.process_count() == 1
+            else f"{obs.replica_id}.rank{jax.process_index()}"
+        )
+        fleet_trace_handler = LocalRawHandler(
+            os.path.join(fleet_trace_dir, f"{stream}.spans.jsonl")
+        )
+        _nd.get_manager().register_handler(fleet_trace_handler)
+        if own_nd_trace:
+            fleet_trace_every = max(
+                1, envreg.get_int("VESCALE_FLEET_TRACE_FLUSH_EVERY") or 1
+            )
     # cold-start retry_after_s seed: with a calibration table armed the
     # decode step is priceable before anything has run; the first prefill
     # wall time (below) covers the un-calibrated case
@@ -508,11 +546,24 @@ def run_serve_resilient(
                     idle_sleep_s = envreg.get_float("VESCALE_SERVE_IDLE_S")
                 if idle_sleep_s:
                     time.sleep(idle_sleep_s)
+            if fleet_trace_every and step % fleet_trace_every == 0:
+                # crash-durable tracing: this boundary's spans reach the
+                # raw stream before the next decode step can kill us
+                _nd.flush()
             step += 1
     finally:
         result.steps = step
         result.outcomes = dict(scheduler.outcomes)
         result.counts = dict(scheduler.counts)
+        if fleet_trace_handler is not None:
+            if fleet_trace_every:
+                _nd.flush()  # the drain's final spans must be harvestable
+            _nd.get_manager().unregister_handler(fleet_trace_handler)
+            if own_nd_trace:
+                # restore the dormant state this loop found: a second run
+                # in the same process must not double-register or inherit
+                # a live profiler it never asked for
+                _nd.deinit_ndtimers()
         if own_ops and ops is not None:
             ops.stop()
         if own_wd:

@@ -32,9 +32,13 @@ from typing import Dict, Optional
 
 __all__ = [
     "ServeObservability",
+    "FleetObservability",
     "ROUTER_SCHEMA_VERSION",
     "ROUTER_FIELDS",
     "ROUTER_FIELDS_V1",
+    "FLEET_SCHEMA_VERSION",
+    "FLEET_FIELDS",
+    "FLEET_REPLICA_FIELDS",
 ]
 
 ROUTER_SCHEMA_VERSION = 2
@@ -69,6 +73,49 @@ ROUTER_FIELDS_V1 = frozenset(
 # (False while draining or actively shedding — the pre-dispatch
 # exclusion signal).  docs/serving.md documents the v1 -> v2 delta.
 ROUTER_FIELDS = ROUTER_FIELDS_V1 | frozenset(("replica_id", "accepting"))
+
+# the router-side `/fleet` rollup schema, frozen under the same contract
+# as ROUTER_FIELDS (fields only ever added, asserted at the source and by
+# tests): the live view an operator — or ROADMAP item 2's auto-plan
+# search — reads to decide a replica is degrading before its breaker
+# trips.  docs/serving.md documents every field.
+FLEET_SCHEMA_VERSION = 1
+FLEET_FIELDS = frozenset(
+    (
+        "schema_version",
+        "healthy_replicas",
+        "pending_requests",
+        "counts",
+        "replicas",
+        "breaker_transitions",
+        "goodput_tokens_per_s",
+        "throughput_tokens_per_s",
+        "mfu",
+        "ttft_p99_s",
+        "shed_rate",
+        "slo_ttft_s",
+        "slo_burn_rate",
+        "uptime_s",
+    )
+)
+# per-replica row of the `/fleet` feed (frozen with the outer schema)
+FLEET_REPLICA_FIELDS = frozenset(
+    (
+        "breaker",
+        "accepting",
+        "queue_depth",
+        "inflight",
+        "shed_rate",
+        "goodput_tokens_per_s",
+        "throughput_tokens_per_s",
+        "mfu",
+        "serve_step",
+        "dispatches",
+        "opens",
+        "reopens",
+        "closes",
+    )
+)
 
 
 def _pcts(hist) -> Dict[str, Optional[float]]:
@@ -201,6 +248,10 @@ class ServeObservability:
                 else None
             ),
             "uptime_s": round(now - self._start, 6),
+            # this replica's wall clock at reply-build time: the fleet
+            # clock-sync rounds (fleettrace.estimate_fleet_clock_offsets)
+            # sample it NTP-style against the poller's own clock
+            "wall_time_us": int(time.time() * 1e6),
         }
 
     def router(self) -> Dict:
@@ -238,3 +289,157 @@ class ServeObservability:
         }
         assert set(out) == ROUTER_FIELDS  # the freeze, enforced at source
         return out
+
+
+class FleetObservability:
+    """Fleet-scope health rollups over a :class:`~.router.FleetRouter`'s
+    cached replica feeds, breaker states and ledger — the router-side
+    twin of :class:`ServeObservability`.
+
+    Owns the numbers no single replica can answer: aggregate goodput and
+    throughput (sums over feeds), fleet MFU (throughput-weighted mean),
+    the fleet p99 TTFT (worst replica — the tail a client actually
+    sees), per-replica shed rates, the breaker state-transition history,
+    and the p99-TTFT **SLO burn rate** (fleet p99 / SLO budget: > 1
+    means the fleet is currently burning error budget; sustained > 1 is
+    the page).  Served three ways: the ``/fleet`` ops endpoint (frozen
+    schema ``FLEET_FIELDS``), the ``fleet_timeline_*`` registry gauges
+    (the ``fleet-timeline:`` dashboard block), and the router process's
+    own ``/metrics``.  Everything works with telemetry dormant — gauges
+    are simply skipped (the ServeObservability contract)."""
+
+    def __init__(self, router, slo_ttft_s: Optional[float] = None):
+        from ..analysis import envreg
+
+        self.router = router
+        if slo_ttft_s is None:
+            slo_ttft_s = envreg.get_float("VESCALE_SERVE_SLO_TTFT_S") or 0.0
+        self.slo_ttft_s = float(slo_ttft_s)
+        self._start = time.perf_counter()
+
+    # ------------------------------------------------------------ rollups
+    def _rollup(self) -> Dict:
+        feeds = {
+            h.id: h.feed for h in self.router.replicas.values() if h.feed is not None
+        }
+        goodput = sum(float(f.get("goodput_tokens_per_s") or 0.0) for f in feeds.values())
+        raw = sum(float(f.get("throughput_tokens_per_s") or 0.0) for f in feeds.values())
+        # fleet MFU: throughput-weighted mean over replicas reporting one
+        # (equal weights when nothing has throughput yet)
+        num = den = 0.0
+        for f in feeds.values():
+            mfu = f.get("mfu")
+            if mfu is None:
+                continue
+            w = float(f.get("throughput_tokens_per_s") or 0.0) or 1.0
+            num += float(mfu) * w
+            den += w
+        fleet_mfu = (num / den) if den else None
+        p99s = [
+            (f.get("ttft_s") or {}).get("p99")
+            for f in feeds.values()
+            if isinstance(f.get("ttft_s"), dict)
+        ]
+        p99s = [p for p in p99s if p is not None]
+        ttft_p99 = max(p99s) if p99s else None
+        burn = (
+            ttft_p99 / self.slo_ttft_s
+            if (self.slo_ttft_s > 0 and ttft_p99 is not None)
+            else None
+        )
+        counts = self.router.ledger.counts
+        shed_rate = counts["shed"] / max(1, counts["submitted"])
+        return {
+            "feeds": feeds,
+            "goodput": goodput,
+            "raw": raw,
+            "mfu": fleet_mfu,
+            "ttft_p99": ttft_p99,
+            "burn": burn,
+            "shed_rate": shed_rate,
+        }
+
+    def fleet(self) -> Dict:
+        """`/fleet`: the aggregated fleet feed — FROZEN schema
+        (``FLEET_FIELDS`` outer, ``FLEET_REPLICA_FIELDS`` per replica;
+        fields only ever added, the ROUTER_FIELDS contract)."""
+        r = self._rollup()
+        replicas = {}
+        for h in self.router.replicas.values():
+            f = h.feed or {}
+            row = {
+                "breaker": h.breaker.state,
+                "accepting": bool(f.get("accepting", not f.get("draining", False)))
+                if f
+                else False,
+                "queue_depth": f.get("queue_depth"),
+                "inflight": f.get("inflight"),
+                "shed_rate": f.get("shed_rate"),
+                "goodput_tokens_per_s": f.get("goodput_tokens_per_s"),
+                "throughput_tokens_per_s": f.get("throughput_tokens_per_s"),
+                "mfu": f.get("mfu"),
+                "serve_step": f.get("serve_step"),
+                "dispatches": h.dispatches,
+                "opens": h.breaker.opens,
+                "reopens": h.breaker.reopens,
+                "closes": h.breaker.closes,
+            }
+            assert set(row) == FLEET_REPLICA_FIELDS  # frozen at source
+            replicas[h.id] = row
+        out = {
+            "schema_version": FLEET_SCHEMA_VERSION,
+            "healthy_replicas": sum(
+                1 for h in self.router.replicas.values() if h.breaker.dispatchable
+            ),
+            "pending_requests": self.router.ledger.pending_count(),
+            "counts": dict(self.router.ledger.counts),
+            "replicas": replicas,
+            "breaker_transitions": list(self.router.breaker_transitions)[-64:],
+            "goodput_tokens_per_s": r["goodput"],
+            "throughput_tokens_per_s": r["raw"],
+            "mfu": r["mfu"],
+            "ttft_p99_s": r["ttft_p99"],
+            "shed_rate": r["shed_rate"],
+            "slo_ttft_s": self.slo_ttft_s,
+            "slo_burn_rate": r["burn"],
+            "uptime_s": round(time.perf_counter() - self._start, 6),
+        }
+        assert set(out) == FLEET_FIELDS  # the freeze, enforced at source
+        return out
+
+    def health(self) -> Dict:
+        """Router-process `/healthz`: liveness + the wall clock the fleet
+        clock sync samples (not frozen — the /fleet feed is the API)."""
+        return {
+            "ok": True,
+            "role": "router",
+            "replicas": len(self.router.replicas),
+            "healthy_replicas": sum(
+                1 for h in self.router.replicas.values() if h.breaker.dispatchable
+            ),
+            "pending_requests": self.router.ledger.pending_count(),
+            "uptime_s": round(time.perf_counter() - self._start, 6),
+            "wall_time_us": int(time.time() * 1e6),
+        }
+
+    def publish(self) -> None:
+        """Push the rollups into the gated registry as ``fleet_timeline_*``
+        gauges — the ``fleet-timeline:`` dashboard block.  No-op while
+        telemetry is dormant."""
+        from .. import telemetry as _tel
+
+        if not _tel.is_active():
+            return
+        r = self._rollup()
+        _tel.set_gauge("fleet_timeline_goodput_tokens_per_s", r["goodput"])
+        _tel.set_gauge("fleet_timeline_throughput_tokens_per_s", r["raw"])
+        if r["mfu"] is not None:
+            _tel.set_gauge("fleet_timeline_mfu", r["mfu"])
+        if r["ttft_p99"] is not None:
+            _tel.set_gauge("fleet_timeline_ttft_p99_s", r["ttft_p99"])
+        if r["burn"] is not None:
+            _tel.set_gauge("fleet_timeline_slo_burn_rate", r["burn"])
+        _tel.set_gauge("fleet_timeline_shed_rate", r["shed_rate"])
+        for rid, f in r["feeds"].items():
+            if f.get("shed_rate") is not None:
+                _tel.set_gauge(f"fleet_timeline_shed_rate_{rid}", f["shed_rate"])

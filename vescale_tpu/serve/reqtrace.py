@@ -52,6 +52,7 @@ __all__ = [
     "evict",
     "terminal",
     "request_spans",
+    "classify_chains",
     "verify_request_chains",
 ]
 
@@ -85,14 +86,18 @@ def _record(metric: str, start: float, duration: float, tags: Dict) -> None:
 # "now" at emission — the two clocks only need to agree over the span's
 # own length, never absolutely.
 
-def submit(rid: int, step: int) -> None:
-    """The chain's root: a zero-duration span at submission, flow SEND."""
+def submit(rid: int, step: int, tag: Optional[int] = None) -> None:
+    """The chain's root: a zero-duration span at submission, flow SEND.
+    ``tag`` is the request's opaque dispatch-attempt token (the fleet
+    router stamps one per placement): carrying it on the submit span is
+    what lets ``fleettrace.assemble_fleet_timeline`` stitch this replica
+    chain to the router's dispatch-attempt span by construction."""
     if not is_active():
         return
-    _record(
-        _p.SERVE_SUBMIT, time.time(), 0.0,
-        {"rid": rid, "flow_id": _flow(rid), "flow_role": "send"},
-    )
+    tags = {"rid": rid, "flow_id": _flow(rid), "flow_role": "send"}
+    if tag is not None:
+        tags["tag"] = tag
+    _record(_p.SERVE_SUBMIT, time.time(), 0.0, tags)
 
 
 def queue_wait(rid: int, slot: int, wait_s: float, replays: int = 0) -> None:
@@ -189,11 +194,47 @@ def request_spans(spans: Sequence) -> Dict[int, Dict[str, List]]:
     return out
 
 
-def verify_request_chains(spans: Sequence, outcomes: Dict[int, Dict]) -> List[str]:
+def classify_chains(
+    spans: Sequence, outcomes: Dict[int, Dict],
+    superseded: Optional[Sequence[int]] = None,
+) -> Dict[int, str]:
+    """Classify each rid's local span chain against a ledger:
+    ``"ledger-matched"`` (the rid has a local terminal outcome),
+    ``"superseded-by-failover"`` (the chain is stranded/incomplete here
+    because the fleet router re-drove the request elsewhere — killed or
+    partitioned replica, hedge loser; ``superseded`` names those rids,
+    e.g. from ``fleettrace.superseded_rids``), or ``"orphan"`` (a chain
+    no ledger and no failover explains — a verification failure)."""
+    sup = {int(r) for r in (superseded or ())}
+    ledger_rids = {int(r) for r in outcomes}
+    out: Dict[int, str] = {}
+    for rid in request_spans(spans):
+        if rid in ledger_rids:
+            out[rid] = "ledger-matched"
+        elif rid in sup:
+            out[rid] = "superseded-by-failover"
+        else:
+            out[rid] = "orphan"
+    return out
+
+
+def verify_request_chains(
+    spans: Sequence, outcomes: Dict[int, Dict],
+    superseded: Optional[Sequence[int]] = None,
+) -> List[str]:
     """The taxonomy<->ledger lockstep check: every terminal ledger outcome
     must have a COMPLETE span chain, and every chain must end in a ledger
     outcome.  Returns a list of problem strings (empty == consistent); the
     serve-obs smoke asserts it empty per rank over the merged trace.
+
+    ``superseded``: rids whose chain on THIS replica may legitimately be
+    incomplete or unmatched because the fleet router re-drove the request
+    on another replica (failover off a killed/partitioned replica, a
+    hedge loser, a shed spill-over) — those chains classify as
+    ``superseded-by-failover`` (:func:`classify_chains`) and are exempt
+    from every check instead of failing verification as orphan chains.
+    Compute the set from the fleet ledger with
+    ``fleettrace.superseded_rids(ledger, replica_id)``.
 
     Completeness per outcome:
       * >=1 ``serve-submit`` span and >=1 ``serve-terminal`` span whose
@@ -213,8 +254,13 @@ def verify_request_chains(spans: Sequence, outcomes: Dict[int, Dict]) -> List[st
     verify each rank's stream against the (agreed) ledger separately.
     """
     problems: List[str] = []
+    sup = {int(r) for r in (superseded or ())}
     chains = request_spans(spans)
     for rid, out in sorted(outcomes.items()):
+        if int(rid) in sup:
+            # resolved elsewhere in the fleet: any local row/chain is a
+            # stale prior attempt — not this replica's to account for
+            continue
         status = out.get("status")
         if status not in TERMINAL_OUTCOMES:
             problems.append(f"rid {rid}: non-terminal ledger status {status!r}")
@@ -270,6 +316,6 @@ def verify_request_chains(spans: Sequence, outcomes: Dict[int, Dict]) -> List[st
             )
     ledger_rids = {int(r) for r in outcomes}
     for rid in sorted(chains):
-        if rid not in ledger_rids:
+        if rid not in ledger_rids and rid not in sup:
             problems.append(f"rid {rid}: span chain with no ledger outcome (orphan)")
     return problems

@@ -72,11 +72,21 @@ Telemetry rides the gated registry (``fleet:`` dashboard block):
 ``fleet_failover_total``, ``fleet_hedge_total``, ``fleet_shed_total``,
 ``fleet_poll_failures_total``, ``fleet_breaker_{open,reopen,close}_total``
 and the ``fleet_healthy_replicas`` / ``fleet_pending_requests`` gauges.
+
+Observability (ISSUE 14): with the ndtimeline profiler live every routed
+request emits its router-side journey chain (``fleet-submit ->
+fleet-dispatch-attempt[i] -> fleet-terminal``, plus backoff forks and
+breaker transitions as spans — serve/fleettrace.py), the dispatch tag
+doubling as the trace context that stitches to replica chains; the
+:class:`~.obs.FleetObservability` aggregator (``self.obs``) rolls the
+cached feeds into fleet health (``/fleet`` via :meth:`start_ops`,
+``fleet_timeline_*`` gauges, the ``fleet-timeline:`` dashboard block).
 """
 
 from __future__ import annotations
 
 import bisect
+import collections
 import dataclasses
 import json
 import time
@@ -85,6 +95,7 @@ import urllib.request
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from . import fleettrace
 from .scheduler import Request, TERMINAL
 
 __all__ = [
@@ -337,6 +348,7 @@ class FleetLedger:
         self.records[rec.req.rid] = rec
         self._pending[rec.req.rid] = rec
         self.counts["submitted"] += 1
+        fleettrace.fleet_submit(rec.req.rid, session=rec.session)
 
     def dispatched(self, rec: FleetRecord, replica_id: str, now: float) -> None:
         rec.attempts.append((replica_id, now))
@@ -362,6 +374,11 @@ class FleetLedger:
         rec.live_on.clear()
         self.counts[status] += 1
         self._pending.pop(rec.req.rid, None)
+        fleettrace.fleet_terminal(
+            rec.req.rid, status, replica_id,
+            tokens=len((outcome or {}).get("tokens") or ()),
+            failovers=rec.failovers,
+        )
         return True
 
     def pending(self) -> List[FleetRecord]:
@@ -522,6 +539,16 @@ class FleetRouter:
         self.ring = ConsistentHashRing()
         self.ledger = FleetLedger()
         self._tag_counter = 0  # router-unique dispatch-attempt tokens
+        # breaker state-transition history (bounded): the /fleet feed's
+        # breaker_transitions tail, and the source of fleet-breaker spans
+        self.breaker_transitions: collections.deque = collections.deque(maxlen=256)
+        # fleet health aggregator: rollups over the cached feeds + ledger
+        # (the /fleet provider + fleet_timeline_* gauges); import here to
+        # keep obs.py -> router.py import-order freedom
+        from .obs import FleetObservability
+
+        self.obs = FleetObservability(self)
+        self._ops = None  # router-side ops server (start_ops)
 
     # ---------------------------------------------------------- lifecycle
     def add_replica(self, replica_id: str, client) -> None:
@@ -559,7 +586,14 @@ class FleetRouter:
             )
             if not due:
                 continue
+            pre_state = h.breaker.state
             disposition = h.breaker.poll_disposition()
+            if (
+                pre_state == CircuitBreaker.OPEN
+                and h.breaker.state == CircuitBreaker.HALF_OPEN
+            ):
+                self._note_transition(h.id, pre_state, h.breaker.state,
+                                      "cooldown elapsed")
             if disposition == "skip":
                 continue
             was_open = h.breaker.state != CircuitBreaker.CLOSED
@@ -585,7 +619,14 @@ class FleetRouter:
                 continue
             h.feed = feed
             h.pending_local = 0
+            pre_state = h.breaker.state
             h.breaker.record_success()
+            if pre_state != CircuitBreaker.CLOSED:
+                self._note_transition(
+                    h.id, pre_state, CircuitBreaker.CLOSED,
+                    "probe success" if pre_state == CircuitBreaker.HALF_OPEN
+                    else "poll success",
+                )
             if was_open and h.breaker.state == CircuitBreaker.CLOSED:
                 _tel.count("fleet_breaker_close_total")
                 _tel.record_event("fleet_readmit", replica=h.id)
@@ -594,11 +635,26 @@ class FleetRouter:
             sum(1 for h in self.replicas.values() if h.breaker.dispatchable),
         )
 
+    def _note_transition(self, replica_id: str, old: str, new: str, reason: str) -> None:
+        """One breaker state transition: append to the bounded history
+        (the /fleet feed's ``breaker_transitions`` tail), emit the
+        fleet-breaker span, count it."""
+        from .. import telemetry as _tel
+
+        self.breaker_transitions.append({
+            "ts": time.time(), "replica": replica_id,
+            "from": old, "to": new, "reason": reason,
+        })
+        fleettrace.breaker_transition(replica_id, old, new, reason)
+        _tel.count("fleet_breaker_transitions_total")
+
     def _record_failure(self, h: _Replica, why: str) -> None:
         from .. import telemetry as _tel
 
         before = h.breaker.state
         h.breaker.record_failure()
+        if h.breaker.state != before:
+            self._note_transition(h.id, before, h.breaker.state, why)
         _tel.count("fleet_poll_failures_total")
         if h.breaker.state == CircuitBreaker.OPEN and before != CircuitBreaker.OPEN:
             _tel.count(
@@ -738,26 +794,50 @@ class FleetRouter:
                         return self._fleet_shed(rec, "no healthy replica")
                 # replicas exist but none eligible yet (unpolled feeds,
                 # backoffs): bounded wait then try again
-                self._sleep(min(backoff, max(0.0, self._remaining(rec))))
+                wait = min(backoff, max(0.0, self._remaining(rec)))
+                fleettrace.backoff(rec.req.rid, wait, "no eligible replica")
+                self._sleep(wait)
                 backoff = min(backoff * 2, self.backoff_max_s)
                 continue
             self._tag_counter += 1
             tag = self._tag_counter
+            # span tag only — skip the recompute entirely while dormant
+            # (this is the hop cost the bench's <1% bar measures)
+            score = (
+                self.score(h.feed, h.pending_local)
+                if (h.feed and fleettrace.is_active())
+                else None
+            )
+            t0 = time.perf_counter()
             try:
                 resp = h.client.submit(
                     request_payload(rec.req, session=rec.session, tag=tag)
                 )
             except ReplicaUnreachable:
+                fleettrace.dispatch_attempt(
+                    rec.req.rid, h.id, tag, kind, time.perf_counter() - t0,
+                    score=score, ok=False, reason="unreachable",
+                )
                 self._record_failure(h, "submit")
                 excluded.append(h.id)
-                self._sleep(min(backoff, max(0.0, self._remaining(rec))))
+                wait = min(backoff, max(0.0, self._remaining(rec)))
+                fleettrace.backoff(rec.req.rid, wait, f"{h.id} unreachable")
+                self._sleep(wait)
                 backoff = min(backoff * 2, self.backoff_max_s)
                 continue
             if not resp.get("accepted", True):
                 # synchronous backpressure: honor the replica's retry hint
+                fleettrace.dispatch_attempt(
+                    rec.req.rid, h.id, tag, kind, time.perf_counter() - t0,
+                    score=score, ok=False, reason="rejected",
+                )
                 self._backoff_replica(h, resp.get("retry_after_s"))
                 excluded.append(h.id)
                 continue
+            fleettrace.dispatch_attempt(
+                rec.req.rid, h.id, tag, kind, time.perf_counter() - t0,
+                score=score,
+            )
             now = self._now()
             h.pending_local += 1
             h.dispatches += 1
@@ -895,6 +975,7 @@ class FleetRouter:
                     )
         pending = self.ledger.pending_count()
         _tel.set_gauge("fleet_pending_requests", pending)
+        self.obs.publish()  # fleet_timeline_* rollup gauges (dormant-gated)
         return pending
 
     def _on_outcome(self, rec: FleetRecord, h: _Replica, out: Dict[str, Any]) -> None:
@@ -939,6 +1020,34 @@ class FleetRouter:
                     f"{[r.req.rid for r in self.ledger.pending()]}"
                 )
             self._sleep(slice_s)
+
+    # --------------------------------------------------------- router ops
+    def start_ops(self, port: Optional[int] = None):
+        """Start the ROUTER-side ops endpoints: ``/fleet`` (the aggregated
+        fleet rollup, frozen schema ``obs.FLEET_FIELDS``), ``/healthz``
+        (router liveness + wall clock) and ``/metrics`` (this process's
+        registry — the ``fleet_*`` counters live here).  Gated exactly
+        like the replica endpoints: ``port`` overrides
+        ``VESCALE_FLEET_OPS_PORT``; unset = OFF (no socket, no thread,
+        returns None); 0 = auto-assign (read ``.port`` back)."""
+        from ..analysis import envreg
+        from ..telemetry import ops_server as _ops
+
+        if port is None:
+            port = envreg.get_int("VESCALE_FLEET_OPS_PORT")
+        if port is None:
+            return None
+        srv = _ops.OpsServer(port=int(port))
+        srv.register("fleet", self.obs.fleet)
+        srv.register("healthz", self.obs.health)
+        srv.start()
+        self._ops = srv
+        return srv
+
+    def stop_ops(self) -> None:
+        if self._ops is not None:
+            self._ops.stop()
+            self._ops = None
 
     # ---------------------------------------------------------- reporting
     def fleet_ledger_check(self) -> None:

@@ -239,7 +239,7 @@ class ContinuousBatchingScheduler:
             self.counts["resubmitted"] += 1
             self._fold(17, req.rid, step)
         self.counts["submitted"] += 1
-        reqtrace.submit(req.rid, step)
+        reqtrace.submit(req.rid, step, tag=req.tag)
         reason = self.currently_shedding()
         total = len(req.prompt) + req.max_new_tokens
         if reason is None and total > self.cache.max_seq_len:

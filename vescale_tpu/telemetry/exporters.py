@@ -164,6 +164,11 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     # totals + healthy-replica and pending gauges (serve/router.py +
     # serve/fleet.py feed)
     _fl = ("fleet_",)
+    # fleet-timeline block: the FleetObservability rollups — aggregate
+    # goodput/throughput, fleet MFU, fleet p99 TTFT, per-replica shed
+    # rates and the SLO burn-rate gauge (serve/obs.py publish() feed;
+    # carved out of the fleet_ prefix by its own fleet_timeline_ prefix)
+    _ft = ("fleet_timeline_",)
     # pallas kernel layer: dispatch/fallback decision totals per kernel
     # (kernels/__init__.py feed, riding the same registry gate)
     _kn = ("kernel_",)
@@ -174,8 +179,12 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     tr_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_tr)}
     cp_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_cp)}
     sv_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_sv)}
-    fl_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_fl)}
-    fl_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_fl)}
+    ft_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_ft)}
+    ft_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_ft)}
+    fl_counters = {n: v for n, v in snap["counters"].items()
+                   if n.startswith(_fl) and not n.startswith(_ft)}
+    fl_gauges = {n: v for n, v in snap["gauges"].items()
+                 if n.startswith(_fl) and not n.startswith(_ft)}
     other_gauges = {
         n: v
         for n, v in snap["gauges"].items()
@@ -248,6 +257,15 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
             lines.append(f"  {name:<48} {_fmt(fl_counters[name]):>12}")
         for name in sorted(fl_gauges):
             lines.append(f"  {name:<48} {fl_gauges[name]:>12.6g}")
+    if ft_counters or ft_gauges:
+        # fleet-timeline block: the aggregated fleet health rollups the
+        # /fleet endpoint serves (goodput, MFU, p99 TTFT, shed rates,
+        # SLO burn rate) — the operator's "is a replica degrading" view
+        lines.append("fleet-timeline:")
+        for name in sorted(ft_counters):
+            lines.append(f"  {name:<48} {_fmt(ft_counters[name]):>12}")
+        for name in sorted(ft_gauges):
+            lines.append(f"  {name:<48} {ft_gauges[name]:>12.6g}")
     if res_counters or res_gauges:
         # recovery-event block (resilience/loop.py feed, mirrors memory:):
         # a zero-fault run shows armed-but-quiet counters at 0

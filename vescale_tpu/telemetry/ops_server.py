@@ -22,6 +22,11 @@ the run.  This module serves the replica's network surface from one
                  learns completions without a push channel.
   ``/submit``    POST: one request into the replica's inbox
                  (serve/fleet.py) — the fleet router's dispatch hop.
+  ``/fleet``     (router-side) the aggregated fleet rollup —
+                 ``FleetRouter.start_ops`` registers
+                 ``serve/obs.py::FleetObservability.fleet`` on the
+                 ROUTER process's own server (frozen schema
+                 ``FLEET_FIELDS``, gated by ``VESCALE_FLEET_OPS_PORT``).
 
 Hardening (the fleet front-end depends on it):
 
@@ -65,8 +70,10 @@ Provider = Callable[[], Dict]
 _ACTIVE: Optional["OpsServer"] = None
 _LOCK = threading.Lock()
 
-# GET endpoints a provider may be registered for; /submit is the one POST
-_GET_ENDPOINTS = ("healthz", "router", "outcomes")
+# GET endpoints a provider may be registered for; /submit is the one POST.
+# /fleet is the ROUTER-side aggregate feed (serve/obs.py
+# FleetObservability — the fleet router's own OpsServer registers it).
+_GET_ENDPOINTS = ("healthz", "router", "outcomes", "fleet")
 _POST_ENDPOINTS = ("submit",)
 
 _STATUS_TEXT = {
@@ -104,7 +111,7 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, "text/plain; charset=utf-8",
                        "not found (endpoints: /metrics /healthz /router "
-                       "/outcomes /submit)\n")
+                       "/outcomes /fleet /submit)\n")
 
     def do_POST(self):  # noqa: N802 (stdlib naming)
         ops: "OpsServer" = self.server.ops  # type: ignore[attr-defined]

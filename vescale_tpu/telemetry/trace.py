@@ -46,6 +46,7 @@ __all__ = [
     "ClockSync",
     "estimate_clock_offsets",
     "merge_traces",
+    "stream_process_names",
     "write_perfetto",
     "load_perfetto",
     "spans_from_perfetto",
@@ -139,16 +140,24 @@ def estimate_clock_offsets(
 # ---------------------------------------------------------------- merging
 def _offset_fn(clock) -> "callable":
     if clock is None:
-        return lambda rank: 0.0
-    if isinstance(clock, ClockSync):
-        return clock.offset_s
+        return lambda key: 0.0
+    if hasattr(clock, "offset_s"):  # ClockSync (int ranks) or a
+        return clock.offset_s  # fleet-style sync keyed by stream id
     if isinstance(clock, Mapping):
-        return lambda rank: float(clock.get(rank, 0.0))
+        return lambda key: float(clock.get(key, 0.0))
     raise TypeError(f"clock must be ClockSync, mapping or None, got {type(clock)}")
 
 
+def _is_replica_qualified(span_streams) -> bool:
+    return (
+        isinstance(span_streams, Mapping)
+        and bool(span_streams)
+        and any(not isinstance(k, int) for k in span_streams)
+    )
+
+
 def merge_traces(
-    span_streams: Union[Sequence[Span], Mapping[int, Sequence[Span]]],
+    span_streams: Union[Sequence[Span], Mapping[int, Sequence[Span]], Mapping[str, Sequence[Span]]],
     clock=None,
 ) -> List[Span]:
     """Merge per-rank span streams into ONE stream on rank 0's clock.
@@ -158,9 +167,39 @@ def merge_traces(
     from per-rank ``parse_raw_spans`` files).  ``clock``: a
     :class:`ClockSync` or ``{rank: offset_seconds}``; each span's start is
     shifted by ``-offset(rank)``.  Returns NEW spans sorted by aligned
-    start (inputs are never mutated)."""
+    start (inputs are never mutated).
+
+    **Replica-qualified stream identities** (fleet mode): the mapping keys
+    may be STRINGS (``"router"``, ``"r0"``, ``"r1"``, …) — the shape a
+    multi-replica fleet produces, where two replicas' rank-0 spans would
+    otherwise collide on one pid lane.  Each stream is then assigned its
+    own pid lane (sorted-key order, deterministic), every span gains a
+    ``stream`` tag naming its origin, and the clock offsets are looked up
+    by the SAME key (a ``{key: offset_seconds}`` mapping or anything with
+    an ``offset_s(key)`` method, e.g. ``fleettrace.FleetClockSync``).
+    :func:`stream_process_names` yields the matching
+    ``write_perfetto(process_names=...)`` labels."""
     off = _offset_fn(clock)
     out: List[Span] = []
+    if _is_replica_qualified(span_streams):
+        keys = sorted(span_streams, key=str)
+        pid_of = {k: i for i, k in enumerate(keys)}
+        for k in keys:
+            for s in span_streams[k]:
+                tags = dict(s.tags) if s.tags else {}
+                tags.setdefault("stream", str(k))
+                out.append(
+                    Span(
+                        metric=s.metric,
+                        start=s.start - off(k),
+                        duration=s.duration,
+                        step=s.step,
+                        rank=pid_of[k],
+                        tags=tags,
+                    )
+                )
+        out.sort(key=lambda s: (s.start, s.rank, s.metric))
+        return out
     if isinstance(span_streams, Mapping):
         items: Iterable = (
             (rank, s) for rank, spans in span_streams.items() for s in spans
@@ -180,6 +219,19 @@ def merge_traces(
         )
     out.sort(key=lambda s: (s.start, s.rank, s.metric))
     return out
+
+
+def stream_process_names(span_streams: Mapping) -> Dict[int, str]:
+    """The ``write_perfetto(process_names=...)`` labels matching
+    :func:`merge_traces`' pid assignment: replica-qualified (string-keyed)
+    streams map sorted-key order onto pids 0..n-1; int-keyed streams keep
+    rank == pid."""
+    if not isinstance(span_streams, Mapping):
+        return {}
+    keys = sorted(span_streams, key=str)
+    if _is_replica_qualified(span_streams):
+        return {i: str(k) for i, k in enumerate(keys)}
+    return {int(k): f"rank {k}" for k in keys}
 
 
 def write_perfetto(
