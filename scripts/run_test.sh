@@ -20,6 +20,8 @@ echo "=== resilient serving smoke (train@2 -> serve@1 bit-identical, coordinated
 python scripts/serve_smoke.py || failed=1
 echo "=== serve observability smoke (request span chains ledger-matched, live ops endpoints)"
 python scripts/serve_obs_smoke.py || failed=1
+echo "=== spec+prefix smoke (radix prefix cache + speculative decode bit-identical under coordinated faults)"
+python scripts/spec_prefix_smoke.py || failed=1
 echo "=== fleet smoke (multi-replica router: kill mid-load -> failover -> rejoin, ledger balanced)"
 python scripts/fleet_smoke.py || failed=1
 echo "=== fleet trace smoke (kill+rejoin battery -> ONE stitched fleet timeline, journeys verified)"

@@ -1,0 +1,288 @@
+"""Throughput-multiplier smoke — the acceptance run of ISSUE 15.
+
+Two legs on the 2-process gloo rig (shared session-unique-port harness,
+vescale_tpu.testing), both COORDINATED (the PR-5 control plane exchanges
+scheduler + cache fingerprints — which now carry the prefix tree's page
+refcounts — every step boundary, so any cross-rank divergence in the
+radix tree, shared-page mapping or speculative acceptance raises
+DesyncError before a divergent batch decodes):
+
+  golden    2 procs x 4 devices: plain decode (no prefix cache, no
+            drafter) serves a shared-prefix open-loop load fault-free to
+            completion.  Ledger printed per rank, byte-compared.
+
+  multi     the SAME load with BOTH multipliers ON — radix-tree prefix
+            caching (page-granular shared-prompt pages) + speculative
+            decoding (reduced-depth drafter, k tokens per verify step) —
+            under one-sided fault injections: an `oom` eviction on rank 0
+            targets a slot whose prefix pages are SHARED (the tree and
+            peer slots still hold references — freeing the slot must not
+            free the pages), a `request_timeout` on rank 1.  Both ranks
+            must agree on every decision (ledgers byte-identical), every
+            COMPLETED request's tokens must be BIT-IDENTICAL to golden's
+            (greedy acceptance + deterministic replay), the evicted
+            request's replay must RE-HIT the cache, and the measured
+            prefill-token savings + speculative acceptance rate are
+            printed.
+
+Exit 0 on success.  Wired into scripts/run_test.sh and tier-1 via
+tests/test_spec_prefix.py.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one-sided injections: the control plane must OR-agree both into
+# identical decisions on both ranks
+MULTI_FAULTS = "oom:step=6,rank=0;request_timeout:step=9,rank=1"
+SPEC_K = 4
+DRAFTER_LAYERS = 1
+
+
+def _model_cfg():
+    import jax.numpy as jnp
+
+    from vescale_tpu.models.llama import LlamaConfig
+
+    # kv_heads=8 divides the 8-way (2 procs x 4 devices) serve mesh
+    return LlamaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=8,
+        max_position_embeddings=64,
+        dtype=jnp.float32,
+    )
+
+
+def _arrivals(Request, n=6):
+    """Shared-prefix open-loop load: every prompt starts with the same
+    8-token system prompt (2 full pages at page_size 4), so admissions
+    after the first hit the radix tree.  Step deadlines keep the
+    coordinated legs wall-clock free."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    shared = tuple(int(x) for x in rng.integers(1, 120, 8))
+    out = []
+    for i in range(n):
+        tail = tuple(int(x) for x in rng.integers(1, 120, 1 + (i % 3)))
+        out.append((2 * i, Request(
+            rid=i, prompt=shared + tail, max_new_tokens=4 + (i % 2),
+            deadline_steps=60,
+        )))
+    return out
+
+
+def _ledger_json(res) -> str:
+    rows = {
+        str(rid): {"status": o["status"], "tokens": o["tokens"],
+                   "replays": o.get("replays", 0)}
+        for rid, o in sorted(res.outcomes.items())
+    }
+    return json.dumps({"status": res.status, "outcomes": rows}, sort_keys=True)
+
+
+# --------------------------------------------------------------------- child
+def child(root: str, role: str, world: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import vescale_tpu.distributed as vdist
+
+    if world > 1:
+        vdist.initialize()
+    me = jax.process_index()
+    assert jax.process_count() == world
+
+    import jax.numpy as jnp
+
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.models.llama import Llama
+    from vescale_tpu.serve import (
+        ContinuousBatchingScheduler,
+        KVCacheConfig,
+        PagedKVCache,
+        PrefixCache,
+        Request,
+        ServeEngine,
+        SpeculativeDecoder,
+        run_serve_resilient,
+        slice_drafter_params,
+    )
+
+    cfg = _model_cfg()
+    model = Llama(cfg)
+    # identical params on every rank from the seed — the multiplier
+    # contract is about the serving path, not the restore path (the
+    # train->serve handoff is serve_smoke.py's leg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))["params"]
+
+    ndev = len(jax.devices())
+    mesh = DeviceMesh(("tp",), (ndev,))
+    arrivals = _arrivals(Request)
+
+    def build(prefix: bool):
+        kc = KVCacheConfig(
+            layers=cfg.num_hidden_layers, kv_heads=cfg.num_key_value_heads,
+            head_dim=cfg.head_dim, num_slots=2, page_size=4, pages_per_slot=4,
+        )
+        cache = PagedKVCache(kc, mesh)  # tp-sharded kv heads
+        eng = ServeEngine(cfg, mesh, params, cache)
+        pc = PrefixCache(cache) if prefix else None
+        sched = ContinuousBatchingScheduler(cache, max_queue=16, prefix_cache=pc)
+        return eng, cache, sched, pc
+
+    if role == "golden":
+        eng, cache, sched, _ = build(prefix=False)
+        res = run_serve_resilient(
+            engine=eng, scheduler=sched, arrivals=arrivals,
+            install_signal_handlers=False, coordinate=world > 1,
+            barrier_timeout_s=60.0,
+        )
+        sched.ledger_check()
+        assert res.status == "completed", res.status
+        assert all(o["status"] == "completed" for o in res.outcomes.values())
+        print(f"LEDGER={_ledger_json(res)}")
+        print(f"CACHE_FP={json.dumps(list(cache.fingerprint()))}")
+    elif role == "multi":
+        eng, cache, sched, pc = build(prefix=True)
+        spec = SpeculativeDecoder(
+            eng, slice_drafter_params(params, DRAFTER_LAYERS),
+            drafter_layers=DRAFTER_LAYERS, k=SPEC_K,
+        )
+        res = run_serve_resilient(
+            engine=eng, scheduler=sched, arrivals=arrivals,
+            install_signal_handlers=False, coordinate=world > 1,
+            barrier_timeout_s=60.0, speculative=spec,
+        )
+        sched.ledger_check()
+        assert res.status == "completed", res.status
+        # the injected oom evicted a slot whose prefix pages were shared:
+        # the eviction freed only the SLOT's references...
+        assert res.counts["evicted"] >= 1, res.counts
+        refs = cache._page_refs
+        assert (refs >= 0).all(), "a page refcount went negative"
+        assert int(refs.sum()) == pc.retained_pages, (
+            "page references leaked past the slot drain: "
+            f"{int(refs.sum())} vs tree {pc.retained_pages}"
+        )
+        # ...and the victim's replay RE-HIT the cache (admissions: first
+        # miss + every later admission a hit, replay included)
+        assert pc.stats.hits >= 2, vars(pc.stats)
+        savings = pc.stats.hit_tokens / max(1, pc.stats.prompt_tokens)
+        assert pc.stats.hit_tokens > 0
+        assert spec.drafted > 0 and spec.accept_rate() is not None
+        print(f"LEDGER={_ledger_json(res)}")
+        print(f"CACHE_FP={json.dumps(list(cache.fingerprint()))}")
+        print(f"STATS={json.dumps(dict(prefill_savings=round(savings, 4), hit_tokens=pc.stats.hit_tokens, prompt_tokens=pc.stats.prompt_tokens, spec_accept_rate=round(spec.accept_rate(), 4), drafted=spec.drafted, accepted=spec.accepted, verify_steps=spec.verify_steps, evicted=res.counts['evicted'], timed_out=res.counts['timed_out']), sort_keys=True)}")
+    else:
+        raise SystemExit(f"unknown role {role}")
+    print(f"OK proc {me}")
+
+
+# -------------------------------------------------------------------- driver
+def run_world(root: str, role: str, world: int, extra_env=None, timeout=420):
+    from vescale_tpu.testing import make_child_env, run_gloo_world
+
+    def spawn(port):
+        procs = []
+        for pid in range(world):
+            env = make_child_env(port, pid, world,
+                                 scrub=("VESCALE_FAULTSIM", "VESCALE_KERNELS",
+                                        "VESCALE_SERVE_PREFIX_CACHE"),
+                                 extra=extra_env)
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--child", root, role, str(world)],
+                env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            ))
+        return procs
+
+    return run_gloo_world(spawn, timeout=timeout)
+
+
+def _grep(out: str, prefix: str) -> str:
+    for line in out.splitlines():
+        if line.startswith(prefix):
+            return line[len(prefix):].strip()
+    raise AssertionError(f"no line starting with {prefix!r} in:\n{out[-2000:]}")
+
+
+def check_run(results, label):
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"{label}: proc {pid} rc={rc}\n{out[-5000:]}"
+        assert f"OK proc {pid}" in out, f"{label}: proc {pid}\n{out[-2000:]}"
+
+
+def main() -> None:
+    sys.path.insert(0, REPO)
+    work = tempfile.mkdtemp(prefix="spec_prefix_smoke_")
+    try:
+        t0 = time.monotonic()
+        # ---- golden: plain decode, 2-proc coordinated, fault-free
+        g = run_world(work, "golden", world=2)
+        check_run(g, "golden")
+        g_ledgers = [_grep(out, "LEDGER=") for _, out in g]
+        assert g_ledgers[0] == g_ledgers[1], (
+            "golden ledgers diverged:\n" + g_ledgers[0] + "\n" + g_ledgers[1]
+        )
+        golden = json.loads(g_ledgers[0])
+
+        # ---- multipliers ON + one-sided fault battery
+        m = run_world(work, "multi", world=2,
+                      extra_env={"VESCALE_FAULTSIM": MULTI_FAULTS})
+        check_run(m, "multi")
+        m_ledgers = [_grep(out, "LEDGER=") for _, out in m]
+        assert m_ledgers[0] == m_ledgers[1], (
+            "multiplier ledgers diverged across ranks:\n"
+            + m_ledgers[0] + "\n" + m_ledgers[1]
+        )
+        # the cache digest — refcount events included — stayed
+        # rank-identical through the shared-page eviction
+        m_fps = [_grep(out, "CACHE_FP=") for _, out in m]
+        assert m_fps[0] == m_fps[1], f"cache fingerprints diverged: {m_fps}"
+        multi = json.loads(m_ledgers[0])
+
+        # every COMPLETED request's tokens are BIT-IDENTICAL to golden's
+        # (golden completed everything, so every completed rid compares)
+        completed = 0
+        for rid, row in multi["outcomes"].items():
+            if row["status"] == "completed":
+                completed += 1
+                assert row["tokens"] == golden["outcomes"][rid]["tokens"], (
+                    f"rid {rid} tokens diverged from plain decode:\n"
+                    f"  multi  {row['tokens']}\n"
+                    f"  golden {golden['outcomes'][rid]['tokens']}"
+                )
+        assert completed >= 4, multi  # the battery only times out one
+        stats = json.loads(_grep(m[0][1], "STATS="))
+        assert stats["prefill_savings"] > 0 and stats["drafted"] > 0
+        print(
+            "SPEC PREFIX SMOKE OK: prefix caching + speculative decoding "
+            "bit-identical to plain decode under coordinated faults "
+            f"(2-rank ledgers + refcounted cache digests byte-equal; "
+            f"{completed} completed, prefill savings "
+            f"{stats['prefill_savings']:.1%}, spec accept rate "
+            f"{stats['spec_accept_rate']:.1%} over {stats['drafted']} drafts, "
+            f"replay re-hit after shared-page oom eviction) "
+            f"({time.monotonic() - t0:.1f}s)"
+        )
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[2], sys.argv[3], int(sys.argv[4]))
+    else:
+        main()

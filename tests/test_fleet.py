@@ -824,7 +824,7 @@ def test_serve_replica_over_http_with_router():
         fr.fleet_ledger_check()
         assert fr.ledger.counts["completed"] == 3
         feed = fr.replicas["t0"].feed
-        assert feed["replica_id"] == "t0" and feed["schema_version"] == 2
+        assert feed["replica_id"] == "t0" and feed["schema_version"] == 3
         assert feed["accepting"] is True
     finally:
         box.close()

@@ -189,7 +189,7 @@ def test_maybe_start_auto_port_and_active_registry(monkeypatch):
 
 # ===================================================== providers / schema
 def test_router_schema_frozen_and_json_roundtrip(serve_rig):
-    from vescale_tpu.serve.obs import ROUTER_FIELDS_V1
+    from vescale_tpu.serve.obs import ROUTER_FIELDS_V1, ROUTER_FIELDS_V2
 
     eng, cache = serve_rig
     cache.reset()
@@ -198,16 +198,24 @@ def test_router_schema_frozen_and_json_roundtrip(serve_rig):
     feed = json.loads(json.dumps(obs.router()))
     assert set(feed) == set(ROUTER_FIELDS)
     # the freeze contract across versions: fields are only ever ADDED —
-    # v1 stays a strict subset, so a router written against v1 still runs
-    assert ROUTER_FIELDS_V1 < ROUTER_FIELDS
-    assert set(ROUTER_FIELDS) - set(ROUTER_FIELDS_V1) == {"replica_id", "accepting"}
-    assert feed["schema_version"] == ROUTER_SCHEMA_VERSION == 2
+    # every prior version stays a strict subset, so a router written
+    # against v1 or v2 still runs against a v3 feed
+    assert ROUTER_FIELDS_V1 < ROUTER_FIELDS_V2 < ROUTER_FIELDS
+    assert set(ROUTER_FIELDS_V2) - set(ROUTER_FIELDS_V1) == {"replica_id", "accepting"}
+    assert set(ROUTER_FIELDS) - set(ROUTER_FIELDS_V2) == {
+        "prefix_hit_rate", "spec_accept_rate",
+    }
+    assert feed["schema_version"] == ROUTER_SCHEMA_VERSION == 3
     assert feed["slots"] == 2 and feed["free_slots"] == 2
     assert set(feed["ttft_s"]) == {"p50", "p95", "p99"}
     assert set(feed["itl_s"]) == {"p50", "p95", "p99"}
     # v2 additions: identity + the pre-dispatch exclusion signal
     assert feed["replica_id"] == "robs"
     assert feed["accepting"] is True
+    # v3 additions are null (not 0.0) while the multipliers are off —
+    # "cold" and "disabled" must stay distinguishable
+    assert feed["prefix_hit_rate"] is None
+    assert feed["spec_accept_rate"] is None
     obs.draining = True
     assert obs.router()["accepting"] is False
 

@@ -310,6 +310,14 @@ register("VESCALE_SERVE_REPLICA_ID", "str", None,
          "Stable replica identity published in the `/router` v2 feed (`replica_id`) and used by the fleet router's affinity ring; unset = `rank<process_index>`.")
 register("VESCALE_SERVE_IDLE_S", "float", 0.002,
          "Step-boundary sleep of an inbox-fed serve loop with nothing queued or in flight (keeps an idle replica from spinning a core while staying responsive to new submissions).")
+register("VESCALE_SERVE_PREFIX_CACHE", "bool", False,
+         "Radix-tree prefix caching over the paged KV pool: admission maps cached prompt-prefix pages (page-granular, refcounted) into the new slot and prefills only the suffix; eviction is deterministic LRU over unreferenced leaves (docs/serving.md).")
+register("VESCALE_SERVE_PREFIX_CACHE_PAGES", "int", 0,
+         "Cap on pages the prefix-cache radix tree may retain (LRU leaves are evicted to fit); 0 = bounded only by the page pool itself.")
+register("VESCALE_SPEC_K", "int", 4,
+         "Speculative decoding draft length: tokens the drafter proposes per decode iteration, verified by the target in ONE batched multi-token paged step (compile-time constant — each distinct k compiles once).")
+register("VESCALE_SPEC_DRAFTER_LAYERS", "int", 1,
+         "Decoder-block depth of the speculative drafter: the SAME checkpoint restored at reduced depth (first N blocks + shared embedding/norm/head, params-only through the elastic preflight).")
 
 # --- fleet router (multi-replica serving) ----------------------------
 register("VESCALE_FLEET_POLL_S", "float", 0.05,

@@ -34,6 +34,14 @@ SERVE_DECODE_STEP = "serve-decode-step"
 SERVE_DECODE_TOKEN = "serve-decode-token"
 SERVE_EVICT = "serve-evict"
 SERVE_TERMINAL = "serve-terminal"
+# speculative decoding (serve/speculative.py; ISSUE 15): with a drafter
+# armed each decode iteration forks into a serve-draft span (the drafter's
+# k sequential proposal steps) and a serve-verify span (the target's ONE
+# batched multi-token verify step, tagged with drafted/accepted counts and
+# the running acceptance rate) — both host-lane per-step spans like
+# serve-decode-step, no rid.
+SERVE_DRAFT = "serve-draft"
+SERVE_VERIFY = "serve-verify"
 # fleet-router request journey (serve/fleettrace.py emits; docs/
 # observability.md "Fleet tracing").  Every routed request's ROUTER-side
 # chain is

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from . import fleet, fleettrace, obs, reqtrace, router
+from . import fleet, fleettrace, obs, prefix_cache, reqtrace, router, speculative
 from .engine import ServeEngine
 from .fleet import FleetSupervisor, ReplicaSpec, RequestInbox, serve_replica
 from .fleettrace import (
@@ -32,6 +32,8 @@ from .fleettrace import (
 from .kv_cache import KVCacheConfig, KVCacheOutOfPages, PagedKVCache
 from .loop import ServeResult, run_serve_resilient
 from .obs import FleetObservability, ServeObservability
+from .prefix_cache import PrefixCache
+from .speculative import SpeculativeDecoder, load_drafter_params, slice_drafter_params
 from .router import (
     CircuitBreaker,
     ConsistentHashRing,
@@ -59,6 +61,10 @@ __all__ = [
     "verify_fleet_journeys",
     "run_serve_resilient",
     "load_params",
+    "PrefixCache",
+    "SpeculativeDecoder",
+    "load_drafter_params",
+    "slice_drafter_params",
     "CircuitBreaker",
     "ConsistentHashRing",
     "FleetLedger",
@@ -69,10 +75,12 @@ __all__ = [
     "FleetSupervisor",
     "serve_replica",
     "obs",
+    "prefix_cache",
     "reqtrace",
     "router",
     "fleet",
     "fleettrace",
+    "speculative",
 ]
 
 

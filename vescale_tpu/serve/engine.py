@@ -324,8 +324,18 @@ class ServeEngine:
         def decode(params, kd, vd, table, lengths, tokens):
             x = embed(params, tokens)  # (S, E)
             pos = lengths  # write position of the new token
-            pg = jnp.take_along_axis(table, (pos // page)[:, None], axis=1)[:, 0]
-            off = pos % page
+            # capacity guard: a position past the slot's reserved pages
+            # (a speculative drafter running ahead of the token budget)
+            # writes the reserved null page instead of aliasing a LIVE
+            # page through index clamping.  An UNCOMMITTED slot (length 0
+            # — allocated but not yet prefilled; with prefix caching its
+            # table may already map SHARED pages) must not write either:
+            # no legitimate decode targets a slot before commit_prefill
+            valid = (pos < Pmax * page) & (lengths > 0)
+            safe = jnp.where(valid, pos, 0)
+            pg = jnp.take_along_axis(table, (safe // page)[:, None], axis=1)[:, 0]
+            pg = jnp.where(valid, pg, 0)
+            off = safe % page
             for l in range(c.num_hidden_layers):
                 lp = params[f"layers_{l}"]
                 xn = _rmsnorm(x, lp["input_layernorm"]["weight"], eps).astype(dtype)
@@ -350,6 +360,68 @@ class ServeEngine:
             )
 
         self._decode_fn = jax.jit(decode, donate_argnums=(1, 2))
+
+        # ---- multi-token step factory (speculative verify + prefix-cache
+        # suffix prefill): the token width W is a COMPILE-TIME constant —
+        # each distinct W lowers once into self._multi_fns and never
+        # retraces as requests come and go.  Same attention math as the
+        # single-token decode (paged gather, length mask, fp32 softmax)
+        # with one extra token axis; token i of a slot's window attends
+        # positions <= lengths+i, which includes the window's own earlier
+        # tokens because every window K/V is scattered before the gather.
+        def make_multi(W):
+            def decode_multi(params, kd, vd, table, lengths, tokens):
+                x = embed(params, tokens)  # (S, W, E)
+                pos = lengths[:, None] + jnp.arange(W, dtype=lengths.dtype)[None, :]
+                # same null-page guard as decode: positions past the
+                # slot's reserved pages AND slots awaiting their prefill
+                # (length 0 — whose tables may already map pages SHARED
+                # with live slots) write the null page, never a live one
+                valid = (pos < Pmax * page) & (lengths[:, None] > 0)
+                safe = jnp.where(valid, pos, 0)
+                pg = jnp.take_along_axis(table, safe // page, axis=1)
+                pg = jnp.where(valid, pg, 0)
+                off = safe % page
+                g = H // KV
+                for l in range(c.num_hidden_layers):
+                    lp = params[f"layers_{l}"]
+                    xn = _rmsnorm(x, lp["input_layernorm"]["weight"], eps).astype(dtype)
+                    q = dense(xn, lp["self_attn"]["q_proj"]["kernel"]).reshape(S, W, H, hd)
+                    k = dense(xn, lp["self_attn"]["k_proj"]["kernel"]).reshape(S, W, KV, hd)
+                    v = dense(xn, lp["self_attn"]["v_proj"]["kernel"]).reshape(S, W, KV, hd)
+                    q, k = rotary(q, k, pos, theta)
+                    kd = kd.at[l, pg, off].set(k.astype(kd.dtype))
+                    vd = vd.at[l, pg, off].set(v.astype(vd.dtype))
+                    ks = jnp.take(kd[l], table, axis=0).reshape(S, Tmax, KV, hd)
+                    vs = jnp.take(vd[l], table, axis=0).reshape(S, Tmax, KV, hd)
+                    qg = (q.astype(jnp.float32) * scale).reshape(S, W, KV, g, hd)
+                    s = jnp.einsum("swkgd,stkd->swkgt", qg, ks.astype(jnp.float32))
+                    mask = (
+                        jnp.arange(Tmax, dtype=jnp.int32)[None, None, :]
+                        <= pos[:, :, None]
+                    )
+                    s = jnp.where(mask[:, :, None, None, :], s, -1e30)
+                    p = jax.nn.softmax(s, axis=-1)
+                    o = jnp.einsum("swkgt,stkd->swkgd", p, vs.astype(jnp.float32))
+                    y = o.reshape(S, W, H * hd).astype(dtype)
+                    x = x + dense(y, lp["self_attn"]["o_proj"]["kernel"])
+                    xn2 = _rmsnorm(
+                        x, lp["post_attention_layernorm"]["weight"], eps
+                    ).astype(dtype)
+                    gt = dense(xn2, lp["mlp"]["gate_proj"]["kernel"])
+                    u = dense(xn2, lp["mlp"]["up_proj"]["kernel"])
+                    x = x + dense(jax.nn.silu(gt) * u, lp["mlp"]["down_proj"]["kernel"])
+                logits = head(params, x)  # (S, W, vocab) fp32
+                return (
+                    jax.lax.with_sharding_constraint(logits, rep_sharding),
+                    jax.lax.with_sharding_constraint(kd, cache_sharding),
+                    jax.lax.with_sharding_constraint(vd, cache_sharding),
+                )
+
+            return jax.jit(decode_multi, donate_argnums=(1, 2))
+
+        self._make_multi = make_multi
+        self._multi_fns: Dict[int, Any] = {}
 
     # ---------------------------------------------------------------- API
     def prefill(self, prompt: Sequence[int], slot: int) -> np.ndarray:
@@ -396,6 +468,68 @@ class ServeEngine:
         )
         cache.update(kd, vd)
         return np.asarray(logits)
+
+    def decode_multi(self, tokens: np.ndarray) -> np.ndarray:
+        """One batched MULTI-token paged step (the speculative-verify /
+        suffix-prefill program): for every slot, ``tokens[s, i]``'s K/V
+        lands at position ``lengths[s] + i`` and ``logits[s, i]`` predicts
+        the token AFTER it.  Width is static — one compiled program per
+        distinct W, cached.  Lengths do NOT advance (callers commit only
+        the accepted positions via ``cache.advance``); positions past a
+        slot's reserved pages write the null page and their logits are
+        garbage the host must ignore.  Returns (num_slots, W, vocab)
+        fp32."""
+        cache = self.cache
+        tokens = np.asarray(tokens, np.int32)
+        W = int(tokens.shape[-1])
+        tokens = tokens.reshape(cache.num_slots, W)
+        fn = self._multi_fns.get(W)
+        if fn is None:
+            fn = self._multi_fns[W] = self._make_multi(W)
+        logits, kd, vd = fn(
+            self.params,
+            cache.k.data,
+            cache.v.data,
+            cache.table_array(),
+            cache.lengths_array(),
+            tokens,
+        )
+        cache.update(kd, vd)
+        return np.asarray(logits)
+
+    def prefill_suffix(self, prompt: Sequence[int], slot: int, matched: int) -> np.ndarray:
+        """Prefix-cache hit path: the slot's page table already maps
+        cached pages covering ``prompt[:matched]`` (page-aligned, via
+        ``alloc_shared``) and the cache length sits at ``matched``
+        (``commit_prefill(slot, matched)``); run ONLY the suffix through
+        chunked multi-token paged steps, appending its K/V after the
+        shared prefix, and return the next-token logits row (vocab,)
+        fp32 for the last prompt position."""
+        cache = self.cache
+        n = len(prompt)
+        page = cache.config.page_size
+        if not (0 < matched < n):
+            raise ValueError(f"matched={matched} must be in (0, {n})")
+        if matched % page:
+            raise ValueError(f"matched={matched} is not page-aligned (page={page})")
+        if int(cache.lengths[slot]) != matched:
+            raise ValueError(
+                f"slot {slot} length {int(cache.lengths[slot])} != matched {matched} "
+                "(commit_prefill the shared prefix first)"
+            )
+        W = page  # chunk width: one page per multi-step
+        out: Optional[np.ndarray] = None
+        i = matched
+        while i < n:
+            chunk = [int(t) for t in prompt[i:i + W]]
+            toks = np.zeros((cache.num_slots, W), np.int32)
+            toks[slot, : len(chunk)] = chunk
+            logits = self.decode_multi(toks)
+            for _ in chunk:
+                cache.advance(slot)
+            out = logits[slot, len(chunk) - 1]
+            i += len(chunk)
+        return np.asarray(out)
 
     def decode_flops_per_step(self) -> Optional[float]:
         """XLA's FLOP count for ONE compiled decode step (all slots) — the

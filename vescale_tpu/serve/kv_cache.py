@@ -24,6 +24,18 @@ fully deterministic: allocation pops the lowest free slot and the highest
 free page, so two ranks driving the same request stream hold bit-identical
 tables — the property ``fingerprint()`` exposes to the serve loop's
 control-plane agreement check.
+
+Page sharing (prefix_cache.py rides this): every page carries a refcount.
+Exclusive pages (plain :meth:`alloc`) hold exactly one reference — their
+owning slot.  :meth:`alloc_shared` maps already-written pages into a new
+slot's table (one more reference each), and the radix tree pins cached
+pages with its own reference (:meth:`retain_page`/:meth:`release_page`).
+:meth:`free` only returns a page to the pool when its LAST reference
+drops — a page with refcount > 0 can never be reallocated out from under
+a reader.  Every inc/dec folds into the same event-sourced crc digest as
+alloc/commit/free, and ``fingerprint()`` carries the live reference
+total, so the PR-5/PR-10 cross-rank consistency check catches refcount
+divergence exactly like slot-assignment divergence.
 """
 
 from __future__ import annotations
@@ -31,7 +43,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import zlib
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -165,6 +177,9 @@ class PagedKVCache:
         self.page_table = np.zeros((config.num_slots, config.pages_per_slot), np.int32)
         self.lengths = np.zeros((config.num_slots,), np.int32)
         self._pages_held = np.zeros((config.num_slots,), np.int32)
+        # per-page reference counts: slots + the prefix tree; a page leaves
+        # the free list at refs 0->1 and returns only at refs 1->0
+        self._page_refs = np.zeros((self.num_pages,), np.int32)
         # pop() takes the HIGHEST page / lowest slot — deterministic across
         # ranks by construction (the agreement check hashes the result)
         self._free_pages: List[int] = list(range(1, self.num_pages))
@@ -219,7 +234,16 @@ class PagedKVCache:
         self._digest = zlib.crc32(b, self._digest)
 
     # ---------------------------------------------------------- allocation
-    def alloc(self, prompt_tokens: int, max_new_tokens: int = 0) -> int:
+    def _take_slot(self, slot: Optional[int]) -> int:
+        """Pop the deterministic next free slot, or claim an EXPLICIT one
+        (the speculative drafter mirrors the target cache's slot ids)."""
+        if slot is None:
+            return self._free_slots.pop()
+        self._free_slots.remove(slot)  # ValueError when not free — loud
+        return slot
+
+    def alloc(self, prompt_tokens: int, max_new_tokens: int = 0,
+              slot: Optional[int] = None) -> int:
         """Reserve a slot + every page the request can ever touch; returns
         the slot id.  Raises :class:`KVCacheOutOfPages` when the pool
         cannot cover it (callers gate on :meth:`can_admit`)."""
@@ -234,11 +258,53 @@ class PagedKVCache:
                 f"need slot+{need} pages, have {len(self._free_slots)} slots / "
                 f"{len(self._free_pages)} pages free"
             )
-        slot = self._free_slots.pop()
+        slot = self._take_slot(slot)
         row = self.page_table[slot]
         row[:] = 0
         for i in range(need):
             row[i] = self._free_pages.pop()
+            self._page_refs[row[i]] = 1
+        self._pages_held[slot] = need
+        self.lengths[slot] = 0
+        self._fold(1, slot, need, int(row[0]))
+        return slot
+
+    def alloc_shared(self, shared_pages: Sequence[int], prompt_tokens: int,
+                     max_new_tokens: int = 0, slot: Optional[int] = None) -> int:
+        """Prefix-cache admission: map ``shared_pages`` (already written,
+        already referenced — typically by the radix tree) into the new
+        slot's leading table entries and allocate FRESH pages only for the
+        rest of the request.  The shared pages gain one reference each;
+        the slot's prefill then starts at the shared boundary."""
+        total = prompt_tokens + max_new_tokens
+        if total > self.max_seq_len:
+            raise KVCacheOutOfPages(
+                f"request of {total} tokens exceeds max_seq_len={self.max_seq_len}"
+            )
+        shared = [int(p) for p in shared_pages]
+        need = self.pages_needed(total)
+        if len(shared) > need:
+            raise ValueError(
+                f"{len(shared)} shared pages exceed the {need} the request needs"
+            )
+        fresh = need - len(shared)
+        if not self._free_slots or fresh > len(self._free_pages):
+            raise KVCacheOutOfPages(
+                f"need slot+{fresh} fresh pages, have {len(self._free_slots)} "
+                f"slots / {len(self._free_pages)} pages free"
+            )
+        slot = self._take_slot(slot)
+        row = self.page_table[slot]
+        row[:] = 0
+        for i, p in enumerate(shared):
+            if self._page_refs[p] <= 0:
+                raise ValueError(f"shared page {p} is unreferenced (freed?)")
+            row[i] = p
+            self._page_refs[p] += 1
+            self._fold(4, slot, p, int(self._page_refs[p]))
+        for i in range(len(shared), need):
+            row[i] = self._free_pages.pop()
+            self._page_refs[row[i]] = 1
         self._pages_held[slot] = need
         self.lengths[slot] = 0
         self._fold(1, slot, need, int(row[0]))
@@ -260,16 +326,39 @@ class PagedKVCache:
         self.lengths[slot] += 1
         self._tokens_held += 1
 
+    def can_advance(self, slot: int) -> bool:
+        return self.lengths[slot] < int(self._pages_held[slot]) * self.config.page_size
+
+    def rollback(self, slot: int, length: int) -> None:
+        """Rewind the slot to ``length`` committed positions — the
+        speculative drafter's post-verify rewind (rejected draft positions
+        become uncommitted garbage again, overwritten by the next write).
+        Pages stay reserved; only the length bookkeeping moves."""
+        cur = int(self.lengths[slot])
+        if not (0 <= length <= cur):
+            raise ValueError(f"slot {slot}: rollback to {length} from {cur}")
+        self._tokens_held -= cur - length
+        self.lengths[slot] = length
+        self._fold(7, slot, length)
+
     def free(self, slot: int) -> None:
-        """Release the slot and return its pages to the pool (eviction,
-        completion, timeout — all the same host-side operation)."""
+        """Release the slot; each of its pages drops one reference and
+        returns to the pool only when that was the LAST one (eviction,
+        completion, timeout — all the same host-side operation).  Pages a
+        prefix tree still retains — or another slot still maps — survive
+        with their bytes intact."""
         if slot in self._free_slots:
             return
         held = int(self._pages_held[slot])
         # LIFO return keeps the free list a deterministic function of the
         # alloc/free history (not of dict/set iteration order)
         for i in range(held - 1, -1, -1):
-            self._free_pages.append(int(self.page_table[slot, i]))
+            p = int(self.page_table[slot, i])
+            self._page_refs[p] -= 1
+            if self._page_refs[p] < 0:
+                raise AssertionError(f"page {p} refcount went negative")
+            if self._page_refs[p] == 0:
+                self._free_pages.append(p)
         self._tokens_held -= int(self.lengths[slot])
         self._fold(3, slot, held, int(self.lengths[slot]))
         self.page_table[slot] = 0
@@ -278,13 +367,44 @@ class PagedKVCache:
         self._free_slots.append(slot)
         self._free_slots.sort(reverse=True)
 
+    # ----------------------------------------------------- page references
+    def retain_page(self, page: int) -> None:
+        """One more holder for an ALREADY-REFERENCED page (the radix tree
+        pinning a slot's prefill output).  Folds into the digest like every
+        other allocation event."""
+        if not (0 < page < self.num_pages):
+            raise ValueError(f"page {page} out of range (page 0 is reserved)")
+        if self._page_refs[page] <= 0:
+            raise ValueError(f"page {page} is unreferenced — nothing to retain")
+        self._page_refs[page] += 1
+        self._fold(5, page, int(self._page_refs[page]))
+
+    def release_page(self, page: int) -> None:
+        """Drop one reference (prefix-tree eviction); the page returns to
+        the free pool only when this was the last holder."""
+        if self._page_refs[page] <= 0:
+            raise ValueError(f"page {page} is already unreferenced")
+        self._page_refs[page] -= 1
+        self._fold(6, page, int(self._page_refs[page]))
+        if self._page_refs[page] == 0:
+            self._free_pages.append(page)
+
+    def page_ref(self, page: int) -> int:
+        return int(self._page_refs[page])
+
     def reset(self) -> None:
         """Return every slot and page to the pool (device bytes stay —
         stale pages are legal: nothing reads past a slot's length).  Lets a
         bench/driver reuse one COMPILED engine across runs instead of
-        rebuilding (and recompiling) per run."""
+        rebuilding (and recompiling) per run.  EVERY reference is dropped,
+        the prefix tree's included — a PrefixCache built over this cache
+        must be discarded (or ``reset``) with it, never carried across."""
         for slot in list(self.active_slots()):
             self.free(slot)
+        # drop non-slot holders (a discarded radix tree's retained pages
+        # would otherwise leak out of the pool permanently)
+        self._page_refs[:] = 0
+        self._free_pages = list(range(1, self.num_pages))
 
     # ------------------------------------------------------- device plumbing
     def update(self, k_data, v_data) -> None:
@@ -309,12 +429,15 @@ class PagedKVCache:
         the disagreement.  Event-sourced (every alloc/commit/free folds
         into a running crc; advances keep a token total) so the per-step
         exchange is O(1), and deliberately EXCLUDES device bytes (the null
-        page legally holds scatter garbage)."""
+        page legally holds scatter garbage).  The live page-reference
+        total rides along so shared-prefix refcount divergence trips the
+        same DesyncError as slot-assignment divergence."""
         return (
             self._digest,
             len(self._free_slots),
             len(self._free_pages),
             self._tokens_held,
+            int(self._page_refs.sum()),
         )
 
     def utilization(self) -> float:

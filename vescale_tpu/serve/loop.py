@@ -54,6 +54,8 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from ..resilience import consistency as _cons
 from ..resilience import faultsim as _fs
 from ..resilience.preempt import PreemptionHandler
@@ -73,9 +75,13 @@ __all__ = ["ServeResult", "run_serve_resilient"]
 _COORD_MAGIC = 0x5E47E
 _OR_FIELDS = ("preempt", "oom", "rtimeout", "wall_mask")
 _COORD_FIELDS = ("coord_magic", "step", "preempt", "oom", "rtimeout", "wall_mask", "draining")
+# scheduler.fingerprint() field names, in order: 3 scheduler fields + the
+# cache fingerprint (which grew ``page_refs`` with prefix sharing — the
+# live page-reference total, so shared-page refcount divergence trips the
+# same DesyncError as slot-assignment divergence)
 _FP_FIELDS = (
     "sched_hash", "queue_len", "active", "cache_hash", "free_slots",
-    "free_pages", "tokens_held", "token_crc",
+    "free_pages", "tokens_held", "page_refs",
 )
 
 
@@ -107,6 +113,7 @@ def run_serve_resilient(
     ops: Optional[Any] = None,
     idle_sleep_s: Optional[float] = None,
     replica_id: Optional[str] = None,
+    speculative: Optional[Any] = None,
 ) -> ServeResult:
     """Serve ``arrivals`` (a deterministic open-loop schedule of
     ``(arrival_step, Request)`` pairs, ascending) to completion under the
@@ -131,6 +138,17 @@ def run_serve_resilient(
     injects a pre-started ``OpsServer`` (the caller owns its lifecycle —
     it can keep serving final outcomes after the loop returns); without
     it the loop starts/stops its own via ``VESCALE_SERVE_OPS_PORT``.
+
+    Throughput multipliers (ISSUE 15): a scheduler built with a
+    ``PrefixCache`` (or ``VESCALE_SERVE_PREFIX_CACHE=1``) maps cached
+    prompt-prefix pages at admission and the loop prefills ONLY the
+    suffix (``engine.prefill_suffix``), folding every freshly-prefilled
+    prompt back into the radix tree; ``speculative`` (a
+    ``SpeculativeDecoder``) replaces each single-token decode step with
+    draft-k-then-verify-in-one-batched-step — greedy acceptance keeps the
+    emitted stream BITWISE identical to plain decode, so both multipliers
+    compose with every fault above (an evicted request's replay re-hits
+    the tree; rejected draft tokens roll back uncommitted).
     """
     import jax
 
@@ -180,7 +198,7 @@ def run_serve_resilient(
     # default — maybe_start returns None without creating a thread)
     obs = ServeObservability(
         scheduler, engine=engine, watchdog=wd, rank=jax.process_index(),
-        replica_id=replica_id,
+        replica_id=replica_id, speculative=speculative,
     )
     if ops is not None:
         # a pre-started server (serve/fleet.py): register the live
@@ -246,8 +264,6 @@ def run_serve_resilient(
         scheduler+cache fingerprints agree.  Raises DesyncError (on every
         rank — the gathered matrix is identical everywhere) on divergence
         in slot assignment, queue, page tables or sampled tokens."""
-        import numpy as np
-
         from ..distributed import allgather_ints
 
         fp = scheduler.fingerprint()
@@ -296,8 +312,37 @@ def run_serve_resilient(
             wait_s = max(0.0, inf.admit_wall - inf.submit_wall)
             reqtrace.queue_wait(inf.req.rid, inf.slot, wait_s, replays=inf.replays)
             _tel.observe("serve_ttft_queue_wait_seconds", wait_s)
-            logits = engine.prefill(inf.req.prompt, inf.slot)
-            cache.commit_prefill(inf.slot, len(inf.req.prompt))
+            if inf.prefix_hit:
+                # prefix-cache hit: the slot's leading table entries map
+                # cached pages (alloc_shared) — commit them and run only
+                # the suffix.  The TTFT decomposition still tiles: this
+                # request's prefill component is just smaller.
+                cache.commit_prefill(inf.slot, inf.prefix_hit)
+                logits = engine.prefill_suffix(
+                    inf.req.prompt, inf.slot, inf.prefix_hit
+                )
+            else:
+                logits = engine.prefill(inf.req.prompt, inf.slot)
+                cache.commit_prefill(inf.slot, len(inf.req.prompt))
+            if scheduler.prefix is not None:
+                # adopt the freshly-written full pages into the radix tree
+                # (shared-prefix blocks dedupe against what it holds);
+                # pure function of the admission stream — both ranks grow
+                # bit-identical trees and the retain events fold into the
+                # cache digest the control plane compares
+                scheduler.prefix.insert(
+                    inf.req.prompt, cache.page_table[inf.slot]
+                )
+                hit_rate = scheduler.prefix.stats.hit_rate()
+                if hit_rate is not None:
+                    _tel.set_gauge("serve_prefix_hit_rate", hit_rate)
+            if speculative is not None:
+                # mirror the admission in the drafter cache + its own full
+                # prefill; a drafter pool too full to mirror degrades the
+                # slot to undrafted (plain-speed, still bit-correct)
+                speculative.admit(
+                    inf.slot, inf.req.prompt, inf.req.max_new_tokens
+                )
             tok = engine.greedy(logits)
             _sample(inf.slot, tok)
             now = time.perf_counter()
@@ -457,6 +502,10 @@ def run_serve_resilient(
                                at_step=step, error=str(e))
 
             # ---------------------------------------------- admit + decode
+            if speculative is not None:
+                # free drafter slots whose target terminated since the
+                # last boundary BEFORE admission can reuse the slot ids
+                speculative.sync_slots(scheduler.active)
             if not draining:
                 _prefill_admitted(step)
                 # the prefill-sampled token may already satisfy the request
@@ -474,22 +523,86 @@ def run_serve_resilient(
                 for slot, inf in scheduler.active.items():
                     tokens[slot] = inf.tokens[-1]
                     active_slots.append(slot)
-                logits = engine.decode(tokens)
-                for slot in sorted(active_slots):
-                    cache.advance(slot)
-                    _sample(slot, engine.greedy(logits[slot]))
+                emitted_per_slot = {slot: 1 for slot in active_slots}
+                drafted_rows = (speculative.drafted_slots(active_slots)
+                                if speculative is not None else [])
+                if speculative is None or not drafted_rows:
+                    # plain decode — also the speculative path's fallback
+                    # when EVERY active slot degraded to undrafted (the
+                    # drafter pool couldn't mirror them): the stream is
+                    # the target's argmaxes either way, and k+1 drafter
+                    # launches plus a (k+1)-wide verify that drafts
+                    # nothing would only add cost
+                    logits = engine.decode(tokens)
+                    for slot in sorted(active_slots):
+                        cache.advance(slot)
+                        _sample(slot, engine.greedy(logits[slot]))
+                else:
+                    # draft-then-verify (speculative.py): the drafter
+                    # proposes k tokens per mirrored slot, the target
+                    # scores all of them in ONE batched multi-token paged
+                    # step, and greedy acceptance emits the longest prefix
+                    # the target itself would have produced — the stream
+                    # stays BITWISE plain decode, only the number of
+                    # target launches per token changes
+                    spec = speculative
+                    d0 = time.perf_counter()
+                    drafts = spec.draft(tokens, drafted_rows)
+                    reqtrace.draft(step, spec.k,
+                                   time.perf_counter() - d0, len(drafted_rows))
+                    toks = np.zeros((cache.num_slots, spec.k + 1), np.int32)
+                    for slot in active_slots:
+                        toks[slot, 0] = tokens[slot]
+                        toks[slot, 1:] = drafts[slot]
+                    v0 = time.perf_counter()
+                    vlogits = engine.decode_multi(toks)
+                    verify_s = time.perf_counter() - v0
+                    drafted_now = accepted_now = 0
+                    for slot in sorted(active_slots):
+                        inf = scheduler.active[slot]
+                        budget = inf.req.max_new_tokens - len(inf.tokens)
+                        emitted, accepted = spec.accept(
+                            drafts[slot], vlogits[slot], budget, inf.req.eos_id
+                        )
+                        for tok in emitted:
+                            cache.advance(slot)
+                            _sample(slot, tok)
+                        emitted_per_slot[slot] = len(emitted)
+                        if slot not in spec.undrafted:
+                            drafted_now += min(spec.k, budget)
+                            accepted_now += accepted
+                    spec.drafted += drafted_now
+                    spec.accepted += accepted_now
+                    spec.verify_steps += 1
+                    # rejected draft positions: roll the drafter back to
+                    # the target's committed lengths — their pages stay
+                    # reserved, the bytes become uncommitted garbage
+                    spec.rewind(cache.lengths, drafted_rows)
+                    rate = spec.accept_rate()
+                    reqtrace.verify(step, verify_s, drafted_now,
+                                    accepted_now, rate)
+                    _tel.count("serve_spec_drafted_tokens_total", drafted_now)
+                    _tel.count("serve_spec_accepted_tokens_total", accepted_now)
+                    _tel.count("serve_spec_verify_steps_total")
+                    if rate is not None:
+                        _tel.set_gauge("serve_spec_accept_rate", rate)
                 dt = time.perf_counter() - t0
                 scheduler.observe_step_time(dt)
                 # the batched step's wall time IS each active slot's
                 # inter-token latency: one ITL observation + one
                 # decode-token span (in the slot's lane) per sampled token
+                # (a speculative step amortizes the wall over every token
+                # it emitted for the slot)
                 reqtrace.decode_step(step, dt, len(active_slots))
                 for slot in active_slots:
                     inf = scheduler.active[slot]
-                    scheduler.observe_itl(dt)
-                    reqtrace.decode_token(
-                        inf.req.rid, slot, len(inf.tokens) - 1, dt
-                    )
+                    m = emitted_per_slot[slot]
+                    per_tok = dt / max(1, m)
+                    for j in range(m):
+                        scheduler.observe_itl(per_tok)
+                        reqtrace.decode_token(
+                            inf.req.rid, slot, len(inf.tokens) - m + j, per_tok
+                        )
                 _tel.count("serve_decode_steps_total")
                 obs.on_decode_step(step, dt, len(active_slots))
                 if _fs.fires("replica_kill", ctx=f"serve_step{step}"):

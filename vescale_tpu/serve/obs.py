@@ -36,12 +36,14 @@ __all__ = [
     "ROUTER_SCHEMA_VERSION",
     "ROUTER_FIELDS",
     "ROUTER_FIELDS_V1",
+    "ROUTER_FIELDS_V2",
     "FLEET_SCHEMA_VERSION",
     "FLEET_FIELDS",
     "FLEET_REPLICA_FIELDS",
+    "FLEET_REPLICA_FIELDS_V1",
 ]
 
-ROUTER_SCHEMA_VERSION = 2
+ROUTER_SCHEMA_VERSION = 3
 # the frozen /router v1 field set: the freeze contract says fields are
 # only ever ADDED — v1 must remain a strict subset of every later version
 # (tests assert it), so a router written against v1 keeps working
@@ -72,14 +74,22 @@ ROUTER_FIELDS_V1 = frozenset(
 # fleet router's stable dispatch/affinity identity) and `accepting`
 # (False while draining or actively shedding — the pre-dispatch
 # exclusion signal).  docs/serving.md documents the v1 -> v2 delta.
-ROUTER_FIELDS = ROUTER_FIELDS_V1 | frozenset(("replica_id", "accepting"))
+ROUTER_FIELDS_V2 = ROUTER_FIELDS_V1 | frozenset(("replica_id", "accepting"))
+# schema v3 (ISSUE 15, additive again): `prefix_hit_rate` (fraction of
+# admitted prompt tokens served from radix-tree cached pages; null while
+# the prefix cache is off or cold) and `spec_accept_rate` (fraction of
+# drafted tokens the target accepted; null while speculation is off or
+# before the first verify step) — the cache-warmth signals a fleet
+# router can use to prefer replicas whose session affinity has already
+# earned the prefix pages.  docs/serving.md documents the v2 -> v3 delta.
+ROUTER_FIELDS = ROUTER_FIELDS_V2 | frozenset(("prefix_hit_rate", "spec_accept_rate"))
 
 # the router-side `/fleet` rollup schema, frozen under the same contract
 # as ROUTER_FIELDS (fields only ever added, asserted at the source and by
 # tests): the live view an operator — or ROADMAP item 2's auto-plan
 # search — reads to decide a replica is degrading before its breaker
 # trips.  docs/serving.md documents every field.
-FLEET_SCHEMA_VERSION = 1
+FLEET_SCHEMA_VERSION = 2
 FLEET_FIELDS = frozenset(
     (
         "schema_version",
@@ -99,7 +109,7 @@ FLEET_FIELDS = frozenset(
     )
 )
 # per-replica row of the `/fleet` feed (frozen with the outer schema)
-FLEET_REPLICA_FIELDS = frozenset(
+FLEET_REPLICA_FIELDS_V1 = frozenset(
     (
         "breaker",
         "accepting",
@@ -116,6 +126,11 @@ FLEET_REPLICA_FIELDS = frozenset(
         "closes",
     )
 )
+# fleet schema v2 (additive, rides the /router v3 fields straight
+# through): the per-replica cache-warmth columns of the aggregate view
+FLEET_REPLICA_FIELDS = FLEET_REPLICA_FIELDS_V1 | frozenset(
+    ("prefix_hit_rate", "spec_accept_rate")
+)
 
 
 def _pcts(hist) -> Dict[str, Optional[float]]:
@@ -130,12 +145,13 @@ class ServeObservability:
     """Derived-rate bookkeeping + endpoint providers for one serve loop."""
 
     def __init__(self, scheduler, engine=None, watchdog=None, rank: int = 0,
-                 replica_id: Optional[str] = None):
+                 replica_id: Optional[str] = None, speculative=None):
         from ..analysis import envreg
 
         self.scheduler = scheduler
         self.engine = engine
         self.watchdog = watchdog
+        self.speculative = speculative  # the /router v3 spec_accept_rate source
         self.rank = int(rank)
         # stable fleet identity (schema v2): explicit arg, else the env
         # knob (one replica process = one id), else the rank
@@ -210,7 +226,12 @@ class ServeObservability:
         if _tel.is_active():
             _tel.set_gauge("serve_goodput_tokens_per_s", goodput)
             _tel.set_gauge("serve_throughput_tokens_per_s", raw)
-            flops = self._flops()
+            # MFU numerator is the SINGLE-token decode program's FLOPs;
+            # with speculation on the step wall covers k+1 drafter steps
+            # plus the batched verify instead, so the ratio would be
+            # fiction — publish null (the documented "unavailable" value)
+            # rather than an understated gauge
+            flops = self._flops() if self.speculative is None else None
             if flops and dt_s > 0:
                 self._last_mfu = flops / dt_s / self._peak_flops()
                 _tel.set_gauge("serve_mfu", self._last_mfu)
@@ -256,12 +277,14 @@ class ServeObservability:
 
     def router(self) -> Dict:
         """`/router`: the dispatch feed a multi-replica router polls —
-        FROZEN schema, v2 (ROUTER_FIELDS; docs/serving.md has the
-        v1 -> v2 delta — fields are only ever added)."""
+        FROZEN schema, v3 (ROUTER_FIELDS; docs/serving.md has the
+        v1 -> v2 -> v3 deltas — fields are only ever added)."""
         sched = self.scheduler
         cache = sched.cache
         up = max(1e-9, time.perf_counter() - self._start)
         submitted = max(1, sched.counts["submitted"])
+        prefix = getattr(sched, "prefix", None)
+        spec = self.speculative
         out = {
             "schema_version": ROUTER_SCHEMA_VERSION,
             "rank": self.rank,
@@ -286,6 +309,11 @@ class ServeObservability:
             "decode_steps": self.decode_steps,
             "serve_step": self.serve_step,
             "uptime_s": round(up, 6),
+            # v3: cache warmth — null (never 0.0) while the multiplier is
+            # off or has no samples, so a router can tell "cold" from
+            # "disabled" without a second probe
+            "prefix_hit_rate": prefix.stats.hit_rate() if prefix is not None else None,
+            "spec_accept_rate": spec.accept_rate() if spec is not None else None,
         }
         assert set(out) == ROUTER_FIELDS  # the freeze, enforced at source
         return out
@@ -383,6 +411,10 @@ class FleetObservability:
                 "opens": h.breaker.opens,
                 "reopens": h.breaker.reopens,
                 "closes": h.breaker.closes,
+                # v2: the /router v3 cache-warmth columns, passed through
+                # (absent from an old replica's v2 feed -> null)
+                "prefix_hit_rate": f.get("prefix_hit_rate"),
+                "spec_accept_rate": f.get("spec_accept_rate"),
             }
             assert set(row) == FLEET_REPLICA_FIELDS  # frozen at source
             replicas[h.id] = row

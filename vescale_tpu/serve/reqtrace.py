@@ -49,6 +49,8 @@ __all__ = [
     "prefill",
     "decode_step",
     "decode_token",
+    "draft",
+    "verify",
     "evict",
     "terminal",
     "request_spans",
@@ -64,6 +66,8 @@ SERVE_SPAN_METRICS = frozenset(
         _p.SERVE_PREFILL,
         _p.SERVE_DECODE_STEP,
         _p.SERVE_DECODE_TOKEN,
+        _p.SERVE_DRAFT,
+        _p.SERVE_VERIFY,
         _p.SERVE_EVICT,
         _p.SERVE_TERMINAL,
     )
@@ -145,6 +149,33 @@ def decode_token(rid: int, slot: int, index: int, dur_s: float) -> None:
         _p.SERVE_DECODE_TOKEN, now - dur_s, dur_s,
         {"rid": rid, "slot": slot, "stage": slot, "i": index},
     )
+
+
+def draft(step: int, k: int, dur_s: float, active: int) -> None:
+    """The drafter's k sequential proposal steps for one decode iteration
+    (host lane, like serve-decode-step — speculative decoding only)."""
+    if not is_active():
+        return
+    now = time.time()
+    _record(
+        _p.SERVE_DRAFT, now - dur_s, dur_s,
+        {"serve_step": step, "k": k, "active": active},
+    )
+
+
+def verify(step: int, dur_s: float, drafted: int, accepted: int,
+           accept_rate: Optional[float]) -> None:
+    """The target's ONE batched multi-token verify step: how many draft
+    tokens had a chance this iteration, how many the target accepted, and
+    the RUNNING acceptance rate (the `/router` v3 ``spec_accept_rate``
+    value at emission time)."""
+    if not is_active():
+        return
+    now = time.time()
+    tags = {"serve_step": step, "drafted": drafted, "accepted": accepted}
+    if accept_rate is not None:
+        tags["accept_rate"] = round(float(accept_rate), 4)
+    _record(_p.SERVE_VERIFY, now - dur_s, dur_s, tags)
 
 
 def evict(rid: int, slot: int, reason: str, replays: int) -> None:

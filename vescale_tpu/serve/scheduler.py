@@ -97,6 +97,9 @@ class _InFlight:
     admit_wall: float = 0.0  # perf_counter at admission (wall deadlines)
     tokens: List[int] = dataclasses.field(default_factory=list)
     replays: int = 0
+    # prompt tokens served from cached prefix pages (prefix_cache.py): the
+    # loop prefills only prompt[prefix_hit:]
+    prefix_hit: int = 0
 
 
 class ContinuousBatchingScheduler:
@@ -112,11 +115,22 @@ class ContinuousBatchingScheduler:
         max_queue: Optional[int] = None,
         slo_ttft_s: Optional[float] = None,
         ttft_window: int = 256,
+        prefix_cache: Optional["PrefixCache"] = None,
     ):
         from ..analysis import envreg
         from ..telemetry.registry import Histogram
 
         self.cache = cache
+        # radix-tree prefix cache (prefix_cache.py): admission consults it
+        # for page-granular prompt-prefix hits.  Explicit instance wins;
+        # otherwise VESCALE_SERVE_PREFIX_CACHE=1 builds one from env so
+        # every driver (loop, fleet replica, bench) gets it with zero
+        # call-site changes
+        if prefix_cache is None and envreg.get_bool("VESCALE_SERVE_PREFIX_CACHE"):
+            from .prefix_cache import PrefixCache
+
+            prefix_cache = PrefixCache.from_env(cache)
+        self.prefix = prefix_cache
         self.max_queue = (
             max_queue if max_queue is not None else envreg.get_int("VESCALE_SERVE_MAX_QUEUE")
         )
@@ -288,12 +302,24 @@ class ContinuousBatchingScheduler:
         admitted: List[_InFlight] = []
         while self.queue:
             req, submit_step, submit_wall = self.queue[0]
-            if not self.cache.can_admit(len(req.prompt), req.max_new_tokens):
-                break
+            matched = 0
+            if self.prefix is not None:
+                # the radix tree decides: matched pages map for free and
+                # LRU-unreferenced cached leaves may be evicted to cover
+                # the fresh remainder (prefix_cache.try_admit mutates
+                # nothing but LRU clocks/evictions on failure)
+                got = self.prefix.try_admit(req.prompt, req.max_new_tokens)
+                if got is None:
+                    break
+                slot, matched = got
+            else:
+                if not self.cache.can_admit(len(req.prompt), req.max_new_tokens):
+                    break
+                slot = self.cache.alloc(len(req.prompt), req.max_new_tokens)
             self.queue.popleft()
-            slot = self.cache.alloc(len(req.prompt), req.max_new_tokens)
             inf = _InFlight(req=req, slot=slot, submit_step=submit_step,
-                            admit_step=step, submit_wall=submit_wall)
+                            admit_step=step, submit_wall=submit_wall,
+                            prefix_hit=matched)
             prev = self.outcomes.pop(req.rid, None)  # a replayed eviction
             if prev is not None and prev.get("status") not in ("evicted_replay",):
                 raise RuntimeError(f"request {req.rid} readmitted after terminal {prev}")
@@ -303,6 +329,12 @@ class ContinuousBatchingScheduler:
             self.counts["admitted"] += 1
             admitted.append(inf)
             self._fold(12, req.rid, slot, step)
+            if matched:
+                # the hit is a scheduling decision: fold it so a rank
+                # whose tree diverged desyncs BEFORE the batch decodes
+                self._fold(19, req.rid, matched)
+                _tel.count("serve_prefix_hits_total")
+                _tel.count("serve_prefix_hit_tokens_total", matched)
             _tel.count("serve_requests_admitted_total")
         _tel.set_gauge("serve_queue_depth", len(self.queue))
         _tel.set_gauge("serve_inflight", len(self.active))
