@@ -998,6 +998,181 @@ def bench_serve():
     }))
 
 
+def bench_alerts():
+    """Alert-engine overhead rung (VESCALE_BENCH=alerts): the sensing
+    layer's per-decode-step price — the history-store append plus
+    rule-pack evaluation that ``telemetry.record_step(kind="serve")``
+    runs at every step boundary — priced the quiescent-envelope way and
+    expressed as a fraction of a real decode step.
+
+    The layer has two cost regimes, so the envelope has two legs, each
+    the delta between tight ``record_step`` loops differing ONLY in
+    timeseries+alerts arming (the default serve pack, armed over
+    representative HEALTHY series):
+
+      * guard leg (default cadence/eval-interval): almost every step is
+        rate-limited to two clock-read guards — the price every decode
+        step pays;
+      * fire leg (cadence 0, eval interval 0): EVERY step snapshots the
+        registry into the rings and evaluates every rule — the price a
+        step pays when the limiters come due.
+
+    A real decode step of duration T amortizes to
+    ``guard + fire * T / eval_interval`` (conservative: it bills the
+    store snapshot at the 0.25 s rule cadence though it actually fires
+    at the 1 s sample cadence).  Acceptance: that amortized cost < 1% of
+    the real decode step, with nothing fired while quiescent."""
+    import jax
+    import jax.numpy as jnp
+
+    from vescale_tpu import telemetry
+    from vescale_tpu.analysis import envreg
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.models.llama import Llama, LlamaConfig
+    from vescale_tpu.serve import (
+        ContinuousBatchingScheduler,
+        KVCacheConfig,
+        PagedKVCache,
+        Request,
+        ServeEngine,
+        run_serve_resilient,
+    )
+    from vescale_tpu.telemetry import alerts as _alerts
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+
+    # ------------------------- denominator: a real decode step (the
+    # serve-rung model class), measured with telemetry DORMANT so the
+    # layer under test is absent from its own denominator
+    assert not telemetry.is_active()
+    cfg = LlamaConfig(
+        vocab_size=2048 if on_tpu else 512,
+        hidden_size=256 if on_tpu else 64,
+        intermediate_size=512 if on_tpu else 128,
+        num_hidden_layers=4,
+        num_attention_heads=8,
+        num_key_value_heads=8,
+        max_position_embeddings=128,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    mesh = DeviceMesh(("tp",), (1,), devices=devices[:1])
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))["params"]
+    kc = KVCacheConfig(
+        layers=cfg.num_hidden_layers, kv_heads=cfg.num_key_value_heads,
+        head_dim=cfg.head_dim, num_slots=8, page_size=8, pages_per_slot=8,
+    )
+    cache = PagedKVCache(kc, mesh)
+    engine = ServeEngine(cfg, mesh, params, cache)
+    rng = np.random.default_rng(0)
+    arrivals = [
+        (i // 2, Request(
+            rid=i,
+            prompt=tuple(int(x) for x in rng.integers(1, cfg.vocab_size - 1, 8)),
+            max_new_tokens=8,
+        ))
+        for i in range(32)
+    ]
+
+    def decode_iters():
+        cache.reset()
+        sched = ContinuousBatchingScheduler(cache, max_queue=len(arrivals))
+        iters, last = [], [None]
+
+        def on_step(step, active):
+            now = time.perf_counter()
+            if last[0] is not None:
+                iters.append(now - last[0])
+            last[0] = now
+
+        run_serve_resilient(
+            engine=engine, scheduler=sched, arrivals=arrivals,
+            install_signal_handlers=False, coordinate=False, on_step=on_step,
+        )
+        assert sched.counts["shed"] == 0, sched.counts
+        return iters
+
+    def _median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    decode_iters()  # compile warmup
+    step_real = _median(decode_iters())
+
+    # ------------------------- the layer, isolated from XLA: tight
+    # record_step loops (nothing else in the body), min of two runs
+    def _quiescent_metrics(reg):
+        # representative HEALTHY series: every pack rule has real data to
+        # reduce over, none of it anywhere near a threshold
+        reg.gauge("serve_shed_rate").set(0.0)
+        reg.gauge("serve_queue_depth").set(2.0)
+        reg.gauge("serve_goodput_fraction").set(0.95)
+        reg.gauge("serve_free_pages").set(100.0)
+        h = reg.histogram("serve_ttft_seconds")
+        for _ in range(64):
+            h.observe(0.005)
+
+    def layer_loop(n, armed, cadence=None, eval_s=None):
+        old = os.environ.get("VESCALE_ALERTS_EVAL_INTERVAL_S")  # vescale-lint: disable=VSC201 (save/restore around init)
+        if eval_s is not None:
+            os.environ["VESCALE_ALERTS_EVAL_INTERVAL_S"] = str(eval_s)
+        try:
+            telemetry.init(out_dir=None, memtrack=False, timeseries=armed,
+                           alerts=armed, timeseries_cadence_s=cadence)
+            _quiescent_metrics(telemetry.get_registry())
+            if armed:
+                assert _alerts.get_engine().arm_pack(
+                    "serve", _alerts.serve_rule_pack(slo_ttft_s=1.0))
+            for _ in range(100):  # steady state: rings warm, rules evaluated
+                telemetry.record_step({"q": 2}, kind="serve")
+            t0 = time.perf_counter()
+            for _ in range(n):
+                telemetry.record_step({"q": 2}, kind="serve")
+            per = (time.perf_counter() - t0) / n
+            if armed:
+                p = _alerts.payload()
+                assert p["counts"]["fired"] == 0 and not p["firing"], (
+                    "alert fired during a quiescent bench", p)
+            return per
+        finally:
+            telemetry.shutdown()
+            if eval_s is not None:
+                if old is None:
+                    os.environ.pop("VESCALE_ALERTS_EVAL_INTERVAL_S", None)
+                else:
+                    os.environ["VESCALE_ALERTS_EVAL_INTERVAL_S"] = old
+
+    guard_iters, fire_iters = 20_000, 2_000
+    plain = min(layer_loop(guard_iters, armed=False) for _ in range(2))
+    guard = min(layer_loop(guard_iters, armed=True) for _ in range(2))
+    fire = min(layer_loop(fire_iters, armed=True, cadence=0.0, eval_s=0.0)
+               for _ in range(2))
+    guard_cost = max(0.0, guard - plain)
+    fire_cost = max(0.0, fire - plain)
+
+    eval_interval = envreg.get_float("VESCALE_ALERTS_EVAL_INTERVAL_S")
+    cadence = envreg.get_float("VESCALE_TIMESERIES_CADENCE_S")
+    amortized = guard_cost + fire_cost * step_real / eval_interval
+    frac = amortized / step_real if step_real > 0 else None
+    print(json.dumps({
+        "metric": "alerts_overhead_frac" if on_tpu else "alerts_overhead_frac_cpu",
+        "value": round(frac, 6) if frac is not None else None,
+        "unit": "fraction",
+        "guard_us_per_step": round(guard_cost * 1e6, 3),
+        "fire_us_per_eval": round(fire_cost * 1e6, 2),
+        "amortized_us_per_step": round(amortized * 1e6, 2),
+        "eval_interval_s": eval_interval,
+        "cadence_s": cadence,
+        "step_ms_real": round(step_real * 1e3, 3),
+        "rules_armed": len(_alerts.serve_rule_pack(slo_ttft_s=1.0)),
+        "guard_iters": guard_iters,
+        "fire_iters": fire_iters,
+        "acceptance_lt": 0.01,
+    }))
+    assert frac is not None and frac < 0.01, (frac, guard_cost, fire_cost)
+
+
 def bench_kernels():
     """Kernel rung (VESCALE_BENCH=kernels): per-kernel kernel-vs-XLA wall
     time at 2-3 shapes plus an interpret-mode parity assertion, one JSON
@@ -1386,6 +1561,8 @@ def _dispatch():
         bench_watchdog()
     elif which == "serve":
         bench_serve()
+    elif which == "alerts":
+        bench_alerts()
     elif which == "elastic":
         bench_elastic()
     elif which == "kernels":
@@ -1420,6 +1597,11 @@ def _dispatch():
         print(json.dumps(quantcomm_smoke.run_bench()))
     else:
         main()
+    # orchestrator-internal handshake (not a user knob, so not in envreg):
+    # the parent marks its last-resort CPU child, and that child flags the
+    # stale TPU record through the alert engine
+    if os.environ.get("VESCALE_BENCH_CPU_FALLBACK"):  # vescale-lint: disable=VSC201 (orchestrator-internal handshake)
+        _flag_stale_tpu_record()
 
 
 def _ancestor_pids() -> set:
@@ -1570,6 +1752,7 @@ def _run_child(deadline: float, force_cpu: bool = False, rung: str = None):
     code = "import bench; bench._dispatch()"
     if force_cpu:
         env["JAX_PLATFORMS"] = "cpu"
+        env["VESCALE_BENCH_CPU_FALLBACK"] = "1"
         code = "import jax; jax.config.update('jax_platforms','cpu'); " + code
     timeout = max(60.0, deadline - time.time())
     try:
@@ -1645,6 +1828,48 @@ def _load_lastgood():
     return _read_lastgood_file().get(_bench_mode())
 
 
+def _record_age_days(recorded) -> "int | None":
+    """Whole days since a last-good record's ``recorded`` date (stdlib
+    only — the orchestrator parent computes it too).  None when the date
+    is absent or unparseable (pre-age-field records)."""
+    if not recorded:
+        return None
+    try:
+        then = time.mktime(time.strptime(str(recorded), "%Y-%m-%d"))
+    except (ValueError, OverflowError):
+        return None
+    return max(0, int((time.time() - then) // 86400))
+
+
+def _flag_stale_tpu_record() -> None:
+    """CPU-fallback child: this round's number is degraded and the
+    freshest TPU record is stale — say so through the alert engine, the
+    same surface every other alert uses (live engine: the bench rule
+    pack's ``bench-tpu-stale`` threshold rule fires off the age gauge;
+    dormant: the warn-once ``[alert:bench-tpu-stale]`` fallback line)."""
+    lastgood = _load_lastgood()
+    if lastgood is None:
+        return
+    age = _record_age_days(lastgood.get("recorded"))
+    msg = (
+        f"bench fell back to CPU; freshest TPU record is "
+        f"{age if age is not None else '?'} day(s) old "
+        f"(recorded {lastgood.get('recorded', '?')})"
+    )
+    from vescale_tpu import telemetry as _tel
+    from vescale_tpu.telemetry import alerts as _alerts
+    from vescale_tpu.telemetry import timeseries as _ts
+
+    if _alerts.is_active():
+        _tel.set_gauge("bench_tpu_record_age_days", float(age or 0))
+        _ts.sample("bench", force=True)
+        _alerts.get_engine().arm_pack("bench", _alerts.bench_rule_pack())
+        _alerts.evaluate()
+    else:
+        _alerts.raise_alert("bench-tpu-stale", message=msg, severity="warning",
+                            value=float(age) if age is not None else None)
+
+
 def _orchestrate() -> int:
     """Retry/backoff wrapper so one transient 'TPU backend UNAVAILABLE'
     (round-2 BENCH_r02 rc=1) cannot cost the round its perf number.  Budget-
@@ -1698,9 +1923,18 @@ def _orchestrate() -> int:
     # surface the newest driver-verifiable TPU number alongside the CPU
     # smoke, honestly labelled stale — a TPU-outage round must never leave
     # the record with ONLY a CPU line (VERDICT r4 next #3)
+    # honest labelling: the headline number came off the CPU fallback rung
+    line["degraded"] = True
     lastgood = _load_lastgood()
     if lastgood is not None:
-        line["last_known_tpu"] = {**lastgood, "stale": True}
+        line["last_known_tpu"] = {
+            **lastgood,
+            "stale": True,
+            # how stale, in whole days off the record's own date — the
+            # "down since round 2" arithmetic done once, here, instead of
+            # by every reader of the bench line
+            "age_days": _record_age_days(lastgood.get("recorded")),
+        }
     print(json.dumps(line))
     return 0
 

@@ -1,0 +1,266 @@
+"""Alert-engine smoke — the acceptance run of ISSUE 16.
+
+Two processes: this driver plus ONE serve replica child with the full
+sensing stack armed (``telemetry.init(window=16, timeseries=True,
+alerts=True)``, fleet trace persistence, faultsim).  The child arms the
+serve rule pack itself with a 50 ms TTFT SLO — including the
+multi-window multi-burn-rate rule, whose windows come from the env knobs
+(``VESCALE_ALERTS_BURN_WINDOWS="4:1:2"`` + ``VESCALE_ALERTS_BURN_FOR_S``);
+the serve loop's own later ``arm_pack("serve", ...)`` is the idempotent
+no-op the engine guarantees.  The SLO deliberately does NOT ride
+``VESCALE_SERVE_SLO_TTFT_S``: that knob also arms the scheduler's
+SLO-breach ADMISSION control, which would shed every post-fault request
+and starve the very observations the alert needs to resolve — the alert
+SLO and the admission SLO are separate dials.  An injected
+``slow_decode`` fault stretches the first decode steps far past the SLO;
+the driver feeds continuous traffic over ``/submit`` and watches
+``/alerts`` live.
+
+Proved end to end:
+
+  * the burn-rate rule walks the FULL lifecycle on the live endpoint —
+    the ``/alerts`` history records ``ok->pending``, ``pending->firing``
+    and ``firing->ok`` for ``serve-ttft-slo-burn``, in order, as the
+    fault raises TTFT and the post-fault traffic burns it back down;
+  * while firing, the `/router` v4 feed's inline alert digest names the
+    rule (the fleet router's view without a second endpoint);
+  * the `/alerts` payload round-trips the FROZEN schema v1 over HTTP;
+  * ``alerts_fired_total`` / ``alerts_resolved_total`` appear in the
+    child's Prometheus export (printed to its log after the drain);
+  * the firing renders on the MERGED fleet timeline: the persisted span
+    stream carries ALERT spans for the transitions plus the episode bar
+    covering the degraded region, and they survive the perfetto
+    write/load round trip.
+
+Exit 0 on success.  Wired into scripts/run_test.sh and tier-1 via
+tests/test_alerts.py.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RULE = "serve-ttft-slo-burn"
+SLOW_S = "0.3"        # injected decode stall — 6x the SLO on every step
+SLOW_COUNT = 12       # ~4 s degraded phase, then traffic runs clean
+SLO_TTFT_S = "0.05"   # normal tiny-model TTFT sits well under this
+BURN_WINDOWS = "4:1:2"  # long 4 s / short 1 s, factor 2
+BURN_FOR_S = "0.3"    # the pending hold the smoke must walk through
+
+
+# --------------------------------------------------------------------- child
+def replica_child() -> None:
+    """One serve replica with the sensing stack live: tiny llama, the
+    metric history store + alert engine armed, span stream persisted."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from vescale_tpu import telemetry
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.models.llama import Llama, LlamaConfig
+    from vescale_tpu.serve import (
+        ContinuousBatchingScheduler,
+        KVCacheConfig,
+        PagedKVCache,
+        ServeEngine,
+        serve_replica,
+    )
+
+    from vescale_tpu.telemetry import alerts as _alerts
+
+    # window=16: the p99 TTFT series must ROLL — post-fault traffic has to
+    # displace the degraded observations or the alert can never resolve
+    telemetry.init(out_dir=None, window=16, memtrack=False,
+                   timeseries=True, alerts=True, timeseries_cadence_s=0.05)
+    # arm the pack with the ALERT SLO before the loop arms its own (that
+    # second arm is the engine's idempotent no-op); the burn windows
+    # still come from VESCALE_ALERTS_BURN_WINDOWS / _FOR_S
+    _alerts.get_engine().arm_pack(
+        "serve", _alerts.serve_rule_pack(slo_ttft_s=float(SLO_TTFT_S))
+    )
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, dtype=jnp.float32,
+    )
+    mesh = DeviceMesh(("tp",), (1,), devices=jax.devices()[:1])
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))["params"]
+    kc = KVCacheConfig(
+        layers=cfg.num_hidden_layers, kv_heads=cfg.num_key_value_heads,
+        head_dim=cfg.head_dim, num_slots=2, page_size=4, pages_per_slot=4,
+    )
+    cache = PagedKVCache(kc, mesh)
+    engine = ServeEngine(cfg, mesh, params, cache)
+    scheduler = ContinuousBatchingScheduler(cache)
+    res = serve_replica(
+        engine=engine, scheduler=scheduler, linger_s=1.0, coordinate=False,
+    )
+    # the prom-export proof: the driver greps these lines from the log
+    for line in telemetry.prometheus_dump().splitlines():
+        if line.startswith("alerts_"):
+            print(f"PROM {line}")
+    print(f"replica done status={res.status} counts={json.dumps(res.counts)}")
+    telemetry.shutdown()
+
+
+# -------------------------------------------------------------------- driver
+def _transitions(payload, rule=RULE):
+    return [(h["from"], h["to"]) for h in payload["history"]
+            if h["rule"] == rule]
+
+
+def main() -> None:
+    sys.path.insert(0, REPO)
+    from vescale_tpu.ndtimeline import predefined as P
+    from vescale_tpu.ndtimeline.parser_handler import parse_raw_spans
+    from vescale_tpu.serve import FleetSupervisor, ReplicaSpec, Request
+    from vescale_tpu.serve.fleettrace import (
+        assemble_fleet_timeline,
+        fleet_process_names,
+    )
+    from vescale_tpu.serve.router import HttpReplicaClient, request_payload
+    from vescale_tpu.telemetry.alerts import ALERTS_FIELDS, ALERTS_RULE_FIELDS
+    from vescale_tpu.telemetry.trace import spans_from_perfetto, write_perfetto
+    from vescale_tpu.testing import make_child_env, reserve_port
+
+    work = tempfile.mkdtemp(prefix="alert_smoke_")
+    trace_dir = os.path.join(work, "trace")
+    os.makedirs(trace_dir, exist_ok=True)
+    t0 = time.monotonic()
+
+    env = make_child_env(
+        0, 0, 1, device_count=1,
+        scrub=("VESCALE_FAULTSIM", "VESCALE_SERVE_OPS_PORT",
+               "VESCALE_SERVE_REPLICA_ID", "VESCALE_KERNELS"),
+        extra={
+            "VESCALE_SERVE_MAX_QUEUE": 32,
+            "VESCALE_FAULTSIM": f"slow_decode:call=0,count={SLOW_COUNT}",
+            "VESCALE_FAULTSIM_SLOW_DECODE_S": SLOW_S,
+            "VESCALE_ALERTS_BURN_WINDOWS": BURN_WINDOWS,
+            "VESCALE_ALERTS_BURN_FOR_S": BURN_FOR_S,
+            "VESCALE_FLEET_TRACE_DIR": trace_dir,
+        },
+    )
+    spec = ReplicaSpec(
+        "r0",
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        reserve_port(),
+        env=env,
+        log_path=os.path.join(work, "r0.log"),
+    )
+    sup = FleetSupervisor([spec], max_restarts=0)
+    sup.start()
+    client = HttpReplicaClient(spec.url, timeout_s=2.0)
+    try:
+        # ---- wait for the replica (cold jax import)
+        deadline = time.monotonic() + 120.0
+        while True:
+            sup.poll()
+            try:
+                if client.poll_health().get("ok"):
+                    break
+            except Exception:
+                pass
+            if time.monotonic() > deadline:
+                raise TimeoutError("replica never came up")
+            time.sleep(0.2)
+
+        # ---- continuous traffic: the fault degrades the first decode
+        # steps, then exhausts; the driver pumps requests until the
+        # /alerts history shows the rule walked back to ok
+        rid = 0
+        firing_router_digest = None
+        final = None
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            sup.poll()
+            req = Request(rid=rid, prompt=(1 + rid % 5, 2, 3),
+                          max_new_tokens=3)
+            try:
+                client.submit(request_payload(req))
+            except Exception:
+                pass  # queue-full sheds are fine; the load keeps coming
+            rid += 1
+            alerts = client._get("/alerts")
+            trs = _transitions(alerts)
+            if ("pending", "firing") in trs and firing_router_digest is None:
+                # the /router v4 inline digest while (or just after) firing
+                firing_router_digest = client.poll_router()["alerts"]
+            if ("firing", "ok") in trs:
+                final = alerts
+                break
+            time.sleep(0.05)
+        assert final is not None, (
+            f"rule {RULE} never resolved; last transitions: "
+            f"{_transitions(client._get('/alerts'))}"
+        )
+
+        # ---- the full lifecycle, in order, on the live endpoint
+        trs = _transitions(final)
+        i_p = trs.index(("ok", "pending"))
+        i_f = trs.index(("pending", "firing"))
+        i_r = trs.index(("firing", "ok"))
+        assert i_p < i_f < i_r, trs
+        row = final["rules"][RULE]
+        assert row["kind"] == "burn_rate" and row["fired_count"] >= 1
+        assert final["counts"]["fired"] >= 1
+        assert final["counts"]["resolved"] >= 1
+        print(f"lifecycle ok: {trs}")
+
+        # ---- frozen schema v1 over the wire
+        assert set(final) == ALERTS_FIELDS
+        assert final["schema_version"] == 1 and final["active"] is True
+        for name, r in final["rules"].items():
+            assert set(r) == ALERTS_RULE_FIELDS, name
+        print(f"/alerts schema ok: {sorted(final['rules'])}")
+
+        # ---- the /router v4 inline digest named the firing rule
+        assert firing_router_digest is not None, "never saw the rule firing"
+        assert firing_router_digest["active"] is True
+        assert RULE in firing_router_digest["firing"], firing_router_digest
+        print(f"/router digest ok: {firing_router_digest}")
+    finally:
+        sup.stop_all(grace_s=60.0)
+
+    # ---- prom export (printed by the child after its drain)
+    log = open(os.path.join(work, "r0.log")).read()
+    prom = [ln for ln in log.splitlines() if ln.startswith("PROM ")]
+    metrics = {ln.split()[1] for ln in prom if len(ln.split()) > 1}
+    assert "alerts_fired_total" in metrics, prom
+    assert "alerts_resolved_total" in metrics, prom
+    print(f"prom export ok: {sorted(m for m in metrics if '{' not in m)}")
+
+    # ---- the firing on the merged fleet timeline
+    raw = parse_raw_spans(os.path.join(trace_dir, "r0.spans.jsonl"))
+    merged = assemble_fleet_timeline({"r0": raw})
+    out_json = os.path.join(work, "fleet_timeline.json")
+    write_perfetto(merged, out_json, process_names=fleet_process_names(merged))
+    back = spans_from_perfetto(out_json)
+    alert_spans = [s for s in back if s.metric == P.ALERT
+                   and (s.tags or {}).get("rule") == RULE]
+    transitions = {(s.tags or {}).get("transition") for s in alert_spans}
+    assert "pending->firing" in transitions, transitions
+    assert "firing->ok" in transitions, transitions
+    # the episode bar: one ALERT span COVERING the degraded region
+    episodes = [s for s in alert_spans if (s.tags or {}).get("episode")]
+    assert episodes and all(s.duration > 0 for s in episodes), alert_spans
+    print(f"timeline ok: {len(alert_spans)} ALERT spans, "
+          f"episode {episodes[0].duration * 1e3:.0f} ms")
+
+    shutil.rmtree(work, ignore_errors=True)
+    print(f"ALERT SMOKE PASS ({time.monotonic() - t0:.1f}s)")
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        replica_child()
+    else:
+        main()

@@ -26,6 +26,8 @@ echo "=== fleet smoke (multi-replica router: kill mid-load -> failover -> rejoin
 python scripts/fleet_smoke.py || failed=1
 echo "=== fleet trace smoke (kill+rejoin battery -> ONE stitched fleet timeline, journeys verified)"
 python scripts/fleet_trace_smoke.py || failed=1
+echo "=== alert smoke (slow_decode fault -> burn-rate rule pending->firing->resolved on the live /alerts endpoint)"
+python scripts/alert_smoke.py || failed=1
 for f in tests/test_*.py; do
   echo "=== $f"
   python -m pytest "$f" -q || failed=1

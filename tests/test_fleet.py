@@ -824,7 +824,10 @@ def test_serve_replica_over_http_with_router():
         fr.fleet_ledger_check()
         assert fr.ledger.counts["completed"] == 3
         feed = fr.replicas["t0"].feed
-        assert feed["replica_id"] == "t0" and feed["schema_version"] == 3
+        from vescale_tpu.serve.obs import ROUTER_SCHEMA_VERSION
+
+        assert feed["replica_id"] == "t0"
+        assert feed["schema_version"] == ROUTER_SCHEMA_VERSION
         assert feed["accepting"] is True
     finally:
         box.close()

@@ -175,7 +175,12 @@ def test_census_interval_skips_steps():
 
 # ------------------------------------------------------------------ leaks
 def test_leak_warning_after_monotonic_untagged_growth():
-    telemetry.init(out_dir=None, memtrack_leak_steps=3)
+    # alerts=False: the engine-dormant legacy path — the leak surfaces as
+    # the warn-once [alert:mem-leak] fallback line
+    from vescale_tpu.telemetry import alerts as _alerts
+
+    _alerts.clear_fallback_warned()
+    telemetry.init(out_dir=None, memtrack_leak_steps=3, alerts=False)
     hoard = []
     with pytest.warns(UserWarning, match="possible leak"):
         for i in range(1, 6):
@@ -185,6 +190,25 @@ def test_leak_warning_after_monotonic_untagged_growth():
     reg = telemetry.get_registry()
     assert reg.counter("mem_leak_warnings_total").value == 1  # warn once per run
     assert reg.gauge("mem_untagged_growth_steps").value >= 3
+
+
+def test_leak_routes_through_alert_engine_when_live(recwarn):
+    # with the engine live (the default) the SAME leak raises the
+    # mem-leak alert instead of a warning — one lifecycle for watchers
+    from vescale_tpu.telemetry import alerts as _alerts
+
+    telemetry.init(out_dir=None, memtrack_leak_steps=3)
+    hoard = []
+    for i in range(1, 6):
+        hoard.append(jnp.ones((256 * i,)) + i)
+        telemetry.record_step({"step": i, "step_time_s": 0.01})
+    assert not any("possible leak" in str(w.message) for w in recwarn.list)
+    eng = _alerts.get_engine()
+    st = eng.state_of("mem-leak")
+    assert st is not None and st["state"] == "firing"
+    assert "possible leak" in st["message"]
+    # still counted in the registry (the dashboard's mem block)
+    assert telemetry.get_registry().counter("mem_leak_warnings_total").value == 1
 
 
 def test_no_leak_warning_on_stable_memory(recwarn):
@@ -282,7 +306,11 @@ def test_aot_budget_sources():
 
 
 def test_step_report_attaches_aot_drift_and_gauge(tmp_path):
-    telemetry.init(out_dir=str(tmp_path))
+    # alerts=False: the engine-dormant legacy path still warns one-shot
+    from vescale_tpu.telemetry import alerts as _alerts
+
+    _alerts.clear_fallback_warned()
+    telemetry.init(out_dir=str(tmp_path), alerts=False)
 
     def fn(x):
         return x @ x.T
@@ -295,6 +323,26 @@ def test_step_report_attaches_aot_drift_and_gauge(tmp_path):
     assert report["aot_drift"]["exceeds_tolerance"]
     assert telemetry.get_state().last_step_report is report
     assert "step_report_prog_aot_drift_frac" in telemetry.get_registry().names()
+
+
+def test_aot_drift_routes_through_alert_engine_when_live(tmp_path, recwarn):
+    from vescale_tpu.telemetry import alerts as _alerts
+
+    telemetry.init(out_dir=str(tmp_path))
+
+    def fn(x):
+        return x @ x.T
+
+    x = jnp.ones((16, 16))
+    report = telemetry.write_step_report("prog", fn, x, aot_report=_fake_aot(1.0))
+    assert report["aot_drift"]["exceeds_tolerance"]
+    assert not any("AOT budget" in str(w.message) for w in recwarn.list)
+    st = _alerts.get_engine().state_of("aot-drift-prog")
+    assert st is not None and st["state"] == "firing"
+    # a non-exceeding report (budget == measured, zero drift) resolves it
+    measured = report["aot_drift"]["measured_bytes"]
+    telemetry.write_step_report("prog", fn, x, aot_report=_fake_aot(measured))
+    assert _alerts.get_engine().state_of("aot-drift-prog")["state"] == "ok"
 
 
 def test_real_aot_reports_carry_a_budget():

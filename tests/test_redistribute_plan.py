@@ -190,7 +190,14 @@ def test_planner_memory_budget_and_env_knob(monkeypatch):
         assert plan_redistribute(src, dst) is None
         assert "memory budget" in decline_reason(src, dst)
         r, fallback = _roundtrip(mesh8, src.placements, [Shard(0)], x)
-        assert fallback  # pack/unpack took it, loudly
+        # pack/unpack took it, loudly — with telemetry live the alert
+        # engine owns "loudly": the legacy one-shot warning is swallowed
+        # and a lifecycle-managed redistribute-fallback alert fires instead
+        assert not fallback
+        from vescale_tpu.telemetry import alerts as _alerts
+
+        st = _alerts.get_engine().state_of("redistribute-fallback")
+        assert st is not None and st["state"] == "firing"
         np.testing.assert_array_equal(np.asarray(r.full_tensor()), x)
         assert telemetry.get_registry().counter("redistribute.fallbacks").value == 1
     finally:

@@ -351,6 +351,28 @@ register("VESCALE_COST_CALIBRATION", "str", None,
 register("VESCALE_CLOCK_SYNC_ROUNDS", "int", 8,
          "Rounds of allgather wall-clock exchange used by telemetry.trace.estimate_clock_offsets to estimate per-rank clock offsets (more rounds tighten the residual).")
 
+# --- time-series store / alert engine --------------------------------
+register("VESCALE_TIMESERIES", "bool", True,
+         "Arm the metric time-series store at telemetry.init(): registry counters/gauges/histogram-percentiles gain bounded ring history with tiered downsampling; off = the sample hook stays the dormant no-op reference (docs/observability.md).")
+register("VESCALE_TIMESERIES_CADENCE_S", "float", 1.0,
+         "Minimum seconds between accepted time-series samples — the loops call `timeseries.sample()` every step/poll and the store keeps at most one per cadence.")
+register("VESCALE_TIMESERIES_BASE_LEN", "int", 512,
+         "Ring capacity per downsampling tier, in samples (memory bound per metric = base_len x tiers).")
+register("VESCALE_TIMESERIES_TIER_FACTOR", "int", 8,
+         "How many tier-k samples collapse into one tier-(k+1) sample (mean for value series, last for cumulative series).")
+register("VESCALE_TIMESERIES_TIERS", "int", 3,
+         "Number of downsampling tiers; with the defaults tier 2 retains ~9 hours of history per metric.")
+register("VESCALE_ALERTS", "bool", True,
+         "Arm the SLO alert engine at telemetry.init(): declarative rules evaluate over the time-series store with the pending->firing->resolved lifecycle; off = raise_alert degrades to the legacy one-shot warning (docs/observability.md).")
+register("VESCALE_ALERTS_HISTORY", "int", 256,
+         "Bounded ring of retained alert lifecycle transitions (the `/alerts` history tail).")
+register("VESCALE_ALERTS_EVAL_INTERVAL_S", "float", 0.25,
+         "Minimum seconds between alert-engine evaluations — the per-step evaluate() hook rate-limits itself to this.")
+register("VESCALE_ALERTS_BURN_WINDOWS", "str", None,
+         "Override the SLO burn-rate rule windows as `long:short:factor[,long:short:factor...]` seconds (default 3600:300:14.4,21600:1800:6 — the SRE multi-window pairs).")
+register("VESCALE_ALERTS_BURN_FOR_S", "float", 0.0,
+         "Hold seconds before a burn-rate rule transitions pending -> firing (0 = fire on first evaluation where both windows burn).")
+
 # --- bench harness ---------------------------------------------------
 register("VESCALE_BENCH", "str", None,
          "Which bench rung to run (e.g. `serve`, `redistribute`, `memtrack`, `watchdog`); unset = default MFU line.")
@@ -364,6 +386,10 @@ register("VESCALE_BENCH_BUDGET_S", "float", 1200.0,
          "Wall-clock budget in seconds for the bench driver.")
 register("VESCALE_BENCH_CHILD", "bool", False,
          "Marks a bench subprocess (internal; set by the bench driver).")
+register("VESCALE_BENCH_CPU_FALLBACK", "bool", False,
+         "Marks the orchestrator's last-resort CPU bench child (internal); the "
+         "child flags the stale TPU record through the alert engine "
+         "(bench-tpu-stale).")
 
 # --- AOT report scripts ----------------------------------------------
 register("VESCALE_AOT_MODEL", "str", "8b",

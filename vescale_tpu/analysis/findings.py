@@ -123,6 +123,8 @@ _CODE_DEFS: Tuple[Tuple[str, Severity, str], ...] = (
      "bare except in a retry loop swallows KeyboardInterrupt"),
     ("VSC206", Severity.ERROR,
      "direct pallas_call outside vescale_tpu/kernels (kernel dispatch contract)"),
+    ("VSC207", Severity.WARNING,
+     "ad-hoc warn-once latch outside the alert engine (telemetry/alerts.py)"),
 )
 
 CODES: Dict[str, FindingCode] = {

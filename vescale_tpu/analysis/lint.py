@@ -25,6 +25,14 @@ Five rules, each a lesson this codebase already paid for once:
           kernels reached any other way bypass the ``VESCALE_KERNELS``
           dispatch contract (off-mode byte-identity, interpret-mode
           parity coverage, dispatch/fallback telemetry; docs/kernels.md).
+  VSC207  no ad-hoc warn-once latches: a function that both calls
+          ``warnings.warn``/``<log>.warning`` AND touches a "warned"
+          latch is a hand-rolled alert with no lifecycle — no resolve,
+          no dedup window, no /alerts visibility.  Route it through
+          ``telemetry.alerts.raise_alert`` (the engine dedups and
+          resolves) or annotate the legacy fallback.  The alert engine
+          itself (telemetry/alerts.py owns the ONE sanctioned fallback
+          latch) is exempt.
 
 Plus VSC104 (shared with shardcheck): collective calls under
 rank-divergent ``if``/``while`` conditions — the classic SPMD deadlock.
@@ -100,6 +108,13 @@ class _Lint(ast.NodeVisitor):
         self._loop_depth = 0
         self._is_envreg = os.path.basename(filename) == "envreg.py"
         parts = os.path.normpath(filename).split(os.sep)
+        # VSC207 exemption: the alert engine owns the one sanctioned
+        # warn-once latch (its dormant-mode raise_alert fallback)
+        self._is_alerts = any(
+            a == "telemetry" and b == "alerts.py"
+            for a, b in zip(parts, parts[1:])
+        )
+        self._vsc207_seen: Set[int] = set()
         # exempt ONLY the vescale_tpu/kernels package itself — a nested
         # .../kernels/ directory elsewhere is still subject to VSC206
         self._in_kernels = any(
@@ -221,8 +236,48 @@ class _Lint(ast.NodeVisitor):
                     )
         self.generic_visit(node)
 
+    # ------------------------------------------------------------- VSC207
+    def _check_warn_latch(self, node: ast.FunctionDef) -> None:
+        """A function that both warns and reads/writes a "warned" latch is
+        rolling its own alert lifecycle.  The finding anchors to the warn
+        call (that's the line to migrate or annotate)."""
+        if self._is_alerts:
+            return
+        warn_calls = []
+        has_latch = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = _dotted(sub.func).rsplit(".", 1)[-1]
+                if name in ("warn", "warning"):
+                    warn_calls.append(sub)
+            ident = None
+            if isinstance(sub, ast.Name):
+                ident = sub.id
+            elif isinstance(sub, ast.Attribute):
+                ident = sub.attr
+            elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                ident = sub.value
+            if ident is not None and "warned" in ident.lower():
+                has_latch = True
+        if has_latch:
+            for call in warn_calls:
+                # a def nested in a flagged def would re-flag the same
+                # call — one finding per warn site
+                if id(call) in self._vsc207_seen:
+                    continue
+                self._vsc207_seen.add(id(call))
+                self.emit(
+                    "VSC207",
+                    f"warn-once latch in {node.name!r}: a hand-rolled alert "
+                    "with no lifecycle (no resolve, no dedup window, no "
+                    "/alerts visibility) — raise it through telemetry.alerts."
+                    "raise_alert, or annotate the legacy fallback",
+                    call,
+                )
+
     # ------------------------------------------------------------- VSC204
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_warn_latch(node)
         if node.name in self._handler_names:
             for sub in ast.walk(node):
                 if isinstance(sub, ast.Call):

@@ -54,6 +54,12 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, axis_names=None, 
         elif "check_rep" in _SM_PARAMS:
             kw["check_rep"] = check_vma
     if axis_names is not None and "axis_names" in _SM_PARAMS:
+        # pre-rename jax (< 0.5) drops the partial-manual request: its
+        # ``auto=`` spelling exists but lowers partition-id collectives the
+        # SPMD partitioner rejects, so every axis goes manual inside the
+        # body there.  dmodule._constrain degrades its layout hints to
+        # no-ops in that regime (see _legacy_manual_axes) — same values,
+        # GSPMD just places the buffers without the explicit pins.
         kw["axis_names"] = axis_names
     return _raw_shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
 
@@ -448,7 +454,9 @@ def _compress_telemetry(n_elements: int, itemsize: int, block: int, op: str, n: 
         _WARNED_COUNTERPRODUCTIVE.add((op, n))
         import warnings
 
-        warnings.warn(
+        # a config-review notice latched per (op, n) — the fix is editing
+        # VESCALE_GRAD_COMPRESS, not paging anyone; stays a warning
+        warnings.warn(  # vescale-lint: disable=VSC207
             f"grad_compress='int8' {op} over a mesh dim of {n} moves "
             f"~{int(q_wire)} bytes on the wire vs ~{int(raw_wire)} uncompressed "
             "(the gather-based quantized all-reduce is O(n) in wire bytes) — "

@@ -107,6 +107,25 @@ def _abstract_mesh_ctx():
     return ctx if getattr(ctx, "shape_tuple", None) else None
 
 
+def _legacy_manual_axes():
+    """Mesh axes bound as manual in the CURRENT trace on jax < 0.5.
+
+    Pre-rename jax has no abstract-mesh context and no reliable
+    partial-manual shard_map (collectives.shard_map drops ``axis_names``
+    there), so inside a shard_map body EVERY bound axis is manual.  The
+    legacy axis env is the only way to see that from here; empty outside
+    shard_map (and on jax >= 0.5, where _abstract_mesh_ctx answers
+    instead)."""
+    if getattr(jax.sharding, "get_abstract_mesh", None) is not None:
+        return frozenset()
+    try:
+        from jax._src.core import get_axis_env
+
+        return frozenset(get_axis_env().axis_sizes)
+    except (ImportError, AttributeError):  # pragma: no cover - other jaxes
+        return frozenset()
+
+
 def _constrain(x, placements, mesh: DeviceMesh):
     if placements is None or not isinstance(x, (jax.Array, jnp.ndarray)) or np.isscalar(x):
         return x
@@ -132,6 +151,12 @@ def _constrain(x, placements, mesh: DeviceMesh):
             return None if entry in manual else entry
         spec = PartitionSpec(*(drop_manual(e) for e in spec))
         return jax.lax.with_sharding_constraint(x, spec)
+    # jax < 0.5 + inside shard_map: all bound axes are manual (no partial-
+    # manual there) and a concrete NamedSharding over them raises.  The
+    # constraint is a layout hint, never a semantics change — degrade to a
+    # no-op, the _constrain_auto precedent (pipe/spmd.py).
+    if _legacy_manual_axes():
+        return x
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh.jax_mesh, spec))
 
 

@@ -41,7 +41,9 @@ def loss_parallel():
 
     if not getattr(loss_parallel, "_warned", False):
         loss_parallel._warned = True
-        warnings.warn(
+        # an API-semantics notice to the calling developer, not a runtime
+        # health signal — stays a process-wide warn-once, not an alert
+        warnings.warn(  # vescale-lint: disable=VSC207
             "loss_parallel() performs no dispatch interception on TPU: inside "
             "jit the sharded loss is already efficient via GSPMD; for the "
             "explicit no-full-logits path use vocab_parallel_cross_entropy("

@@ -56,3 +56,9 @@ FLEET_DISPATCH = "fleet-dispatch-attempt"
 FLEET_BACKOFF = "fleet-backoff"
 FLEET_BREAKER = "fleet-breaker"
 FLEET_TERMINAL = "fleet-terminal"
+# alert-engine lifecycle (telemetry/alerts.py emits): a point span per
+# transition plus, on resolve, one span covering the whole firing episode
+# — so a Perfetto timeline shows the alert as a bar spanning exactly the
+# degraded step/request spans beneath it (docs/observability.md
+# "Reading an alert span").
+ALERT = "alert"

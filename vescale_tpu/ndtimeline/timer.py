@@ -66,10 +66,15 @@ class NDTimerManager:
         self._calibration_offset = offset_seconds
 
     # ----------------------------------------------------------- spans
-    def record(self, metric: str, start: float, duration: float, tags=None) -> None:
+    def record(self, metric: str, start: float, duration: float, tags=None,
+               step=None) -> None:
+        """``step`` overrides the counter for spans recorded on behalf of a
+        step that already closed (the alert engine evaluates AFTER the
+        loops advance the counter)."""
         with self._lock:
             self._spans.append(
-                Span(metric, start + self._calibration_offset, duration, self.step, self.rank, tags)
+                Span(metric, start + self._calibration_offset, duration,
+                     self.step if step is None else step, self.rank, tags)
             )
 
     def timeit(self, metric: str, tags=None):

@@ -243,7 +243,17 @@ def _warn_fallback(src: DArraySpec, dst: DArraySpec) -> None:
     key = (src, dst)
     if key not in _warned_pairs:
         _warned_pairs.add(key)
-        warnings.warn(msg, stacklevel=3)
+        from .telemetry import alerts as _alerts
+
+        if _alerts.is_active():
+            # live engine: one lifecycle-managed alert (refreshed per new
+            # pair — the message names the pair), /alerts visibility
+            _alerts.raise_alert(
+                "redistribute-fallback", message=msg, severity="warning"
+            )
+        else:
+            # dormant-engine legacy fallback, deduped by _warned_pairs
+            warnings.warn(msg, stacklevel=3)  # vescale-lint: disable=VSC207
     DebugLogger.log("redistribute", msg)
 
 

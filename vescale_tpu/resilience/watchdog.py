@@ -145,6 +145,12 @@ class Watchdog:
         # stale timestamp still reads as a stall — a duplicate dump (or
         # abort) for a stall that just ended
         self._last_beat = time.monotonic()
+        if self._tripped:
+            # progress after a trip: the stall episode is over — resolve
+            # the alert (no-op while the engine is dormant)
+            from ..telemetry import alerts as _alerts
+
+            _alerts.resolve("watchdog-stall")
         self._tripped = False
 
     @property
@@ -198,6 +204,21 @@ class Watchdog:
             stalled_s=bundle["stalled_s"],
             dump=path,
             abort=self.abort,
+        )
+        # the stall watcher routes through the alert engine (one lifecycle,
+        # /alerts visibility, ALERT timeline span); the stderr print below
+        # stays — an aborting process must leave SOMETHING on the console
+        from ..telemetry import alerts as _alerts
+
+        _alerts.raise_alert(
+            "watchdog-stall",
+            message=(
+                f"no step progress for {bundle['stalled_s']:.1f}s (deadline "
+                f"{self.timeout_s:g}s) at step={self._step} "
+                f"phase={self._phase}; stacks -> {path or '<not written>'}"
+            ),
+            severity="critical",
+            value=bundle["stalled_s"],
         )
         print(
             f"[watchdog] no step progress for {bundle['stalled_s']:.1f}s "

@@ -200,15 +200,29 @@ def run_serve_resilient(
         scheduler, engine=engine, watchdog=wd, rank=jax.process_index(),
         replica_id=replica_id, speculative=speculative,
     )
+    from ..telemetry import alerts as _alerts
+
     if ops is not None:
         # a pre-started server (serve/fleet.py): register the live
         # providers on it; the CALLER owns start/stop — it may keep the
         # port serving final outcomes after this loop returns
         ops.register("healthz", obs.health).register("router", obs.router)
+        ops.register("alerts", _alerts.payload)
         own_ops = False
     else:
-        ops = _ops.maybe_start(health=obs.health, router=obs.router)
+        ops = _ops.maybe_start(health=obs.health, router=obs.router,
+                               extra={"alerts": _alerts.payload})
         own_ops = ops is not None
+    # arm the default serve rule pack on the live alert engine (idempotent
+    # by pack name — a respawned loop in the same process re-arms cleanly);
+    # the TTFT burn rule arms only when an SLO is configured
+    if _alerts.is_active():
+        _alerts.get_engine().arm_pack(
+            "serve",
+            _alerts.serve_rule_pack(
+                slo_ttft_s=envreg.get_float("VESCALE_SERVE_SLO_TTFT_S") or 0.0
+            ),
+        )
     # ---- fleet trace persistence (VESCALE_FLEET_TRACE_DIR): this
     # replica's span stream lands on disk AS THE RUN GOES — flushed every
     # VESCALE_FLEET_TRACE_FLUSH_EVERY boundaries, so even an abrupt

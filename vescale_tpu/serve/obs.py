@@ -37,13 +37,15 @@ __all__ = [
     "ROUTER_FIELDS",
     "ROUTER_FIELDS_V1",
     "ROUTER_FIELDS_V2",
+    "ROUTER_FIELDS_V3",
     "FLEET_SCHEMA_VERSION",
     "FLEET_FIELDS",
+    "FLEET_FIELDS_V2",
     "FLEET_REPLICA_FIELDS",
     "FLEET_REPLICA_FIELDS_V1",
 ]
 
-ROUTER_SCHEMA_VERSION = 3
+ROUTER_SCHEMA_VERSION = 4
 # the frozen /router v1 field set: the freeze contract says fields are
 # only ever ADDED — v1 must remain a strict subset of every later version
 # (tests assert it), so a router written against v1 keeps working
@@ -82,15 +84,23 @@ ROUTER_FIELDS_V2 = ROUTER_FIELDS_V1 | frozenset(("replica_id", "accepting"))
 # before the first verify step) — the cache-warmth signals a fleet
 # router can use to prefer replicas whose session affinity has already
 # earned the prefix pages.  docs/serving.md documents the v2 -> v3 delta.
-ROUTER_FIELDS = ROUTER_FIELDS_V2 | frozenset(("prefix_hit_rate", "spec_accept_rate"))
+ROUTER_FIELDS_V3 = ROUTER_FIELDS_V2 | frozenset(("prefix_hit_rate", "spec_accept_rate"))
+# schema v4 (additive again): `alerts` — the replica's alert-engine
+# digest ({"active", "firing", "pending"}; firing/pending are sorted rule
+# names).  A fleet router can treat a replica with critical rules firing
+# as degraded BEFORE its breaker trips, and the digest rides the feed the
+# router already polls — no second probe.  The full lifecycle snapshot
+# (frozen schema v1) lives on `/alerts`; this is the inline summary.
+# docs/serving.md documents the v3 -> v4 delta.
+ROUTER_FIELDS = ROUTER_FIELDS_V3 | frozenset(("alerts",))
 
 # the router-side `/fleet` rollup schema, frozen under the same contract
 # as ROUTER_FIELDS (fields only ever added, asserted at the source and by
 # tests): the live view an operator — or ROADMAP item 2's auto-plan
 # search — reads to decide a replica is degrading before its breaker
 # trips.  docs/serving.md documents every field.
-FLEET_SCHEMA_VERSION = 2
-FLEET_FIELDS = frozenset(
+FLEET_SCHEMA_VERSION = 3
+FLEET_FIELDS_V2 = frozenset(
     (
         "schema_version",
         "healthy_replicas",
@@ -108,6 +118,11 @@ FLEET_FIELDS = frozenset(
         "uptime_s",
     )
 )
+# fleet schema v3 (additive): `alerts` — the ROUTER process's own
+# alert-engine digest (fleet-scope rules: fleet-shed-rate,
+# fleet-no-healthy-replicas, fleet-ttft-slo-burn), same
+# {"active", "firing", "pending"} shape as /router v4.
+FLEET_FIELDS = FLEET_FIELDS_V2 | frozenset(("alerts",))
 # per-replica row of the `/fleet` feed (frozen with the outer schema)
 FLEET_REPLICA_FIELDS_V1 = frozenset(
     (
@@ -131,6 +146,15 @@ FLEET_REPLICA_FIELDS_V1 = frozenset(
 FLEET_REPLICA_FIELDS = FLEET_REPLICA_FIELDS_V1 | frozenset(
     ("prefix_hit_rate", "spec_accept_rate")
 )
+
+
+def _alerts_digest() -> Dict:
+    """The inline alert summary every feed carries (schema'd by the
+    endpoint that embeds it: /router v4, /fleet v3, /healthz).  Import is
+    local so the providers keep working with telemetry fully dormant."""
+    from ..telemetry import alerts as _alerts
+
+    return _alerts.digest()
 
 
 def _pcts(hist) -> Dict[str, Optional[float]]:
@@ -226,6 +250,16 @@ class ServeObservability:
         if _tel.is_active():
             _tel.set_gauge("serve_goodput_tokens_per_s", goodput)
             _tel.set_gauge("serve_throughput_tokens_per_s", raw)
+            # the serve rule pack's inputs (telemetry/alerts.py): shed
+            # fraction, goodput as a fraction of raw throughput (1.0 when
+            # nothing is wasted; collapses toward 0 under eviction churn),
+            # and page-pool headroom for the drain-trend rule
+            _tel.set_gauge(
+                "serve_shed_rate",
+                sched.counts["shed"] / max(1, sched.counts["submitted"]),
+            )
+            _tel.set_gauge("serve_goodput_fraction", goodput / raw if raw > 0 else 1.0)
+            _tel.set_gauge("serve_free_pages", sched.cache.free_page_count())
             # MFU numerator is the SINGLE-token decode program's FLOPs;
             # with speculation on the step wall covers k+1 drafter steps
             # plus the batched verify instead, so the ratio would be
@@ -273,12 +307,15 @@ class ServeObservability:
             # clock-sync rounds (fleettrace.estimate_fleet_clock_offsets)
             # sample it NTP-style against the poller's own clock
             "wall_time_us": int(time.time() * 1e6),
+            # /healthz is NOT frozen, so the alert digest rides it too —
+            # a probe that only hits /healthz still sees firing rules
+            "alerts": _alerts_digest(),
         }
 
     def router(self) -> Dict:
         """`/router`: the dispatch feed a multi-replica router polls —
-        FROZEN schema, v3 (ROUTER_FIELDS; docs/serving.md has the
-        v1 -> v2 -> v3 deltas — fields are only ever added)."""
+        FROZEN schema, v4 (ROUTER_FIELDS; docs/serving.md has the
+        v1 -> v2 -> v3 -> v4 deltas — fields are only ever added)."""
         sched = self.scheduler
         cache = sched.cache
         up = max(1e-9, time.perf_counter() - self._start)
@@ -314,6 +351,9 @@ class ServeObservability:
             # "disabled" without a second probe
             "prefix_hit_rate": prefix.stats.hit_rate() if prefix is not None else None,
             "spec_accept_rate": spec.accept_rate() if spec is not None else None,
+            # v4: the alert-engine digest ({"active": false, ...} while
+            # dormant) — degradation signal ahead of the breaker
+            "alerts": _alerts_digest(),
         }
         assert set(out) == ROUTER_FIELDS  # the freeze, enforced at source
         return out
@@ -435,6 +475,8 @@ class FleetObservability:
             "slo_ttft_s": self.slo_ttft_s,
             "slo_burn_rate": r["burn"],
             "uptime_s": round(time.perf_counter() - self._start, 6),
+            # v3: the router process's own alert digest (fleet-scope rules)
+            "alerts": _alerts_digest(),
         }
         assert set(out) == FLEET_FIELDS  # the freeze, enforced at source
         return out
@@ -452,6 +494,7 @@ class FleetObservability:
             "pending_requests": self.router.ledger.pending_count(),
             "uptime_s": round(time.perf_counter() - self._start, 6),
             "wall_time_us": int(time.time() * 1e6),
+            "alerts": _alerts_digest(),
         }
 
     def publish(self) -> None:
@@ -463,6 +506,11 @@ class FleetObservability:
         if not _tel.is_active():
             return
         r = self._rollup()
+        # the fleet rule pack's no-healthy-replicas input
+        _tel.set_gauge(
+            "fleet_timeline_healthy_replicas",
+            sum(1 for h in self.router.replicas.values() if h.breaker.dispatchable),
+        )
         _tel.set_gauge("fleet_timeline_goodput_tokens_per_s", r["goodput"])
         _tel.set_gauge("fleet_timeline_throughput_tokens_per_s", r["raw"])
         if r["mfu"] is not None:

@@ -634,6 +634,22 @@ class FleetRouter:
             "fleet_healthy_replicas",
             sum(1 for h in self.replicas.values() if h.breaker.dispatchable),
         )
+        # poll boundary = the router's step boundary: refresh the
+        # fleet_timeline_* rollup gauges, snapshot them into the
+        # time-series store, and run the alert rules over the history
+        # (all three are dormant-gated no-ops without telemetry.init())
+        from ..telemetry import alerts as _alerts
+        from ..telemetry import timeseries as _ts
+
+        self.obs.publish()
+        if _alerts.is_active():
+            # lazy idempotent arming: the router may be built before the
+            # engine comes up, so the pack arms at the first live poll
+            _alerts.get_engine().arm_pack(
+                "fleet", _alerts.fleet_rule_pack(slo_ttft_s=self.obs.slo_ttft_s)
+            )
+        _ts.sample("fleet")
+        _alerts.evaluate()
 
     def _note_transition(self, replica_id: str, old: str, new: str, reason: str) -> None:
         """One breaker state transition: append to the bounded history
@@ -1025,8 +1041,10 @@ class FleetRouter:
     def start_ops(self, port: Optional[int] = None):
         """Start the ROUTER-side ops endpoints: ``/fleet`` (the aggregated
         fleet rollup, frozen schema ``obs.FLEET_FIELDS``), ``/healthz``
-        (router liveness + wall clock) and ``/metrics`` (this process's
-        registry — the ``fleet_*`` counters live here).  Gated exactly
+        (router liveness + wall clock), ``/alerts`` (the router's own
+        alert-engine snapshot — the fleet-scope rules live HERE, not on
+        any replica) and ``/metrics`` (this process's registry — the
+        ``fleet_*`` counters live here).  Gated exactly
         like the replica endpoints: ``port`` overrides
         ``VESCALE_FLEET_OPS_PORT``; unset = OFF (no socket, no thread,
         returns None); 0 = auto-assign (read ``.port`` back)."""
@@ -1037,9 +1055,12 @@ class FleetRouter:
             port = envreg.get_int("VESCALE_FLEET_OPS_PORT")
         if port is None:
             return None
+        from ..telemetry import alerts as _alerts
+
         srv = _ops.OpsServer(port=int(port))
         srv.register("fleet", self.obs.fleet)
         srv.register("healthz", self.obs.health)
+        srv.register("alerts", _alerts.payload)
         srv.start()
         self._ops = srv
         return srv

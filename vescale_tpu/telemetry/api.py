@@ -25,7 +25,9 @@ import os
 import time
 from typing import Any, Callable, Dict, Optional
 
+from . import alerts as _alerts
 from . import memtrack as _memtrack
+from . import timeseries as _timeseries
 from .exporters import JsonlExporter, dashboard as _dashboard, prometheus_text
 from .registry import MetricsRegistry
 
@@ -63,6 +65,8 @@ class TelemetryState:
         self.step = 0
         self.jsonl: Optional[JsonlExporter] = None
         self.memtrack = None  # set by init() when memory tracking is on
+        self.timeseries = None  # set by init() when the history store is on
+        self.alerts = None  # set by init() when the alert engine is on
         self.last_step_report: Optional[Dict] = None  # flight-recorder feed
         if jsonl and out_dir is not None:
             os.makedirs(out_dir, exist_ok=True)
@@ -81,6 +85,9 @@ def init(
     memtrack_interval: int = 1,
     memtrack_history: int = 16,
     memtrack_leak_steps: int = 5,
+    timeseries: Optional[bool] = None,
+    timeseries_cadence_s: Optional[float] = None,
+    alerts: Optional[bool] = None,
 ) -> TelemetryState:
     """Activate telemetry.  ``out_dir=None`` keeps everything in-memory
     (registry only — no JSONL stream, no report files).  Re-initializing
@@ -92,16 +99,46 @@ def init(
     ``memtrack_interval`` steps, a ``memtrack_history``-deep sample ring for
     the OOM flight recorder, and a leak warning after
     ``memtrack_leak_steps`` consecutive steps of monotonic untagged
-    growth."""
+    growth.
+
+    ``timeseries``/``alerts`` (default: the ``VESCALE_TIMESERIES`` /
+    ``VESCALE_ALERTS`` knobs, both on) also activate the metric history
+    store (timeseries.py) and the SLO alert engine (alerts.py) — the
+    engine evaluates over the store, so ``alerts`` implies nothing
+    without ``timeseries`` except manual (code-raised) alerts."""
     global _STATE
     if _STATE is not None:
         shutdown()
+    from ..analysis import envreg
+
     _STATE = TelemetryState(out_dir, rank, window, jsonl)
     if memtrack:
         _STATE.memtrack = _memtrack.activate(
             history=memtrack_history,
             leak_steps=memtrack_leak_steps,
             census_interval=memtrack_interval,
+        )
+    if timeseries is None:
+        timeseries = envreg.get_bool("VESCALE_TIMESERIES")
+    if alerts is None:
+        alerts = envreg.get_bool("VESCALE_ALERTS")
+    if timeseries:
+        _STATE.timeseries = _timeseries.activate(
+            _STATE.registry,
+            cadence_s=(
+                timeseries_cadence_s
+                if timeseries_cadence_s is not None
+                else envreg.get_float("VESCALE_TIMESERIES_CADENCE_S")
+            ),
+            base_len=envreg.get_int("VESCALE_TIMESERIES_BASE_LEN"),
+            tier_factor=envreg.get_int("VESCALE_TIMESERIES_TIER_FACTOR"),
+            tiers=envreg.get_int("VESCALE_TIMESERIES_TIERS"),
+        )
+    if alerts:
+        _STATE.alerts = _alerts.activate(
+            store=_STATE.timeseries,
+            history=envreg.get_int("VESCALE_ALERTS_HISTORY"),
+            min_eval_interval_s=envreg.get_float("VESCALE_ALERTS_EVAL_INTERVAL_S"),
         )
     return _STATE
 
@@ -113,6 +150,8 @@ def shutdown() -> None:
     if _STATE is not None and _STATE.jsonl is not None:
         _STATE.jsonl.close()
     _memtrack.deactivate()
+    _alerts.deactivate()
+    _timeseries.deactivate()
     _STATE = None
 
 
@@ -173,6 +212,12 @@ def record_step(metrics: Dict[str, Any], kind: str = "train") -> None:
         # per-step memory sample: device gauges, tagged census, leak check
         # (None on census-interval skip steps — the jsonl line just omits it)
         mem = st.memtrack.on_step(st.step, reg)
+    # the step boundary IS the sampling/evaluation boundary: the history
+    # store keeps at most one sample per cadence and the engine rate-limits
+    # itself, so a kHz decode loop pays two no-op-ish calls per step
+    # (dormant runs pay the no-op hook references — the memtrack contract)
+    _timeseries.sample(kind)
+    _alerts.evaluate()
     if st.jsonl is not None:
         rec = {"step": st.step, "rank": st.rank, "ts": time.time(), **metrics}
         if kind != "train":
@@ -258,17 +303,24 @@ def write_step_report(
     if drift is not None:
         st.registry.gauge(f"step_report_{name}_aot_drift_frac").set(drift["drift_frac"])
         if drift["exceeds_tolerance"]:
-            import warnings
-
-            warnings.warn(
-                f"step report {name!r}: compiled memory footprint "
-                f"{drift['measured_bytes']:.3e} B drifts "
-                f"{drift['drift_frac'] * 100:+.1f}% from the AOT budget "
-                f"{drift['aot_bytes']:.3e} B ({drift['aot_source']}) — "
-                "beyond the 10% tolerance; re-derive the AOT report or find "
-                "the regression.",
-                stacklevel=2,
+            # the AOT-drift watcher routes through the alert engine (ONE
+            # lifecycle for every watcher); with the engine off this
+            # degrades to the legacy one-shot warning
+            _alerts.raise_alert(
+                f"aot-drift-{name}",
+                message=(
+                    f"step report {name!r}: compiled memory footprint "
+                    f"{drift['measured_bytes']:.3e} B drifts "
+                    f"{drift['drift_frac'] * 100:+.1f}% from the AOT budget "
+                    f"{drift['aot_bytes']:.3e} B ({drift['aot_source']}) — "
+                    "beyond the 10% tolerance; re-derive the AOT report or "
+                    "find the regression."
+                ),
+                severity="warning",
+                value=drift["drift_frac"],
             )
+        else:
+            _alerts.resolve(f"aot-drift-{name}")
     return report
 
 

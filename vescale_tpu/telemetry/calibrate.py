@@ -391,11 +391,25 @@ def clear_warned() -> None:
 
 
 def _warn_once(key: str, message: str) -> None:
+    """Stale/missing-table signals route through the alert engine when it
+    is live (rule ``calibration-<reason>``: one lifecycle, /alerts
+    visibility); the dormant path keeps the legacy per-key one-shot
+    warning so analytic fallbacks stay visible without telemetry."""
     with _LOCK:
         if key in _WARNED:
             return
         _WARNED.add(key)
-    warnings.warn(message, stacklevel=3)
+    from . import alerts as _alerts
+
+    if _alerts.is_active():
+        _alerts.raise_alert(
+            f"calibration-{key.split(':', 1)[0]}", message=message,
+            severity="warning",
+        )
+        return
+    # dormant-engine legacy fallback; live runs route through the
+    # telemetry.alerts branch above
+    warnings.warn(message, stacklevel=3)  # vescale-lint: disable=VSC207
 
 
 def active_table() -> Optional[CalibrationTable]:

@@ -172,6 +172,12 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     # pallas kernel layer: dispatch/fallback decision totals per kernel
     # (kernels/__init__.py feed, riding the same registry gate)
     _kn = ("kernel_",)
+    # alert-engine block: lifecycle totals (fired/resolved/pending), the
+    # live firing-count gauge and the per-rule 0/1/2 state gauges
+    # (telemetry/alerts.py _emit feed)
+    _al = ("alerts_",)
+    al_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_al)}
+    al_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_al)}
     kn_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_kn)}
     kn_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_kn)}
     res_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_res)}
@@ -188,7 +194,7 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     other_gauges = {
         n: v
         for n, v in snap["gauges"].items()
-        if not n.startswith(("mem_",) + _res + _qc + _tr + _cp + _sv + _kn + _fl)
+        if not n.startswith(("mem_",) + _res + _qc + _tr + _cp + _sv + _kn + _fl + _al)
     }
     res_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_res)}
     qc_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_qc)}
@@ -198,7 +204,7 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     other_counters = {
         n: v
         for n, v in snap["counters"].items()
-        if not n.startswith(_res + _qc + _tr + _cp + _sv + _kn + _fl)
+        if not n.startswith(_res + _qc + _tr + _cp + _sv + _kn + _fl + _al)
     }
     if other_counters:
         lines.append("counters:")
@@ -266,6 +272,41 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
             lines.append(f"  {name:<48} {_fmt(ft_counters[name]):>12}")
         for name in sorted(ft_gauges):
             lines.append(f"  {name:<48} {ft_gauges[name]:>12.6g}")
+    if al_counters or al_gauges:
+        # alert-engine block: the lifecycle totals + per-rule state gauges
+        # (0=ok 1=pending 2=firing), then the live engine's firing/pending
+        # rule names spelled out — the dashboard answer to "is anything
+        # paging right now" without hitting /alerts
+        lines.append("alerts:")
+        for name in sorted(al_counters):
+            lines.append(f"  {name:<48} {_fmt(al_counters[name]):>12}")
+        for name in sorted(al_gauges):
+            lines.append(f"  {name:<48} {al_gauges[name]:>12.6g}")
+        from . import alerts as _alerts
+
+        eng = _alerts.get_engine()
+        if eng is not None:
+            firing, pending = eng.firing(), eng.pending()
+            lines.append(f"  firing:  {', '.join(firing) if firing else '(none)'}")
+            if pending:
+                lines.append(f"  pending: {', '.join(pending)}")
+    from . import timeseries as _timeseries
+
+    _store = _timeseries.get_store()
+    if _store is not None:
+        # time-series store block: what the alert rules are evaluated
+        # over — series count, retained samples across all tiers, and the
+        # sampling cadence/tiering shape
+        s = _store.stats()
+        lines.append("timeseries:")
+        lines.append(f"  {'timeseries_series':<48} {_fmt(s['series']):>12}")
+        lines.append(f"  {'timeseries_retained_samples':<48} {_fmt(s['retained_samples']):>12}")
+        lines.append(f"  {'timeseries_samples_taken':<48} {_fmt(s['samples_taken']):>12}")
+        lines.append(f"  {'timeseries_cadence_s':<48} {s['cadence_s']:>12.6g}")
+        lines.append(
+            f"  {'timeseries_tiers':<48} "
+            f"{str(s['tiers']) + ' x ' + str(s['base_len']) + ' @ /' + str(s['tier_factor']):>12}"
+        )
     if res_counters or res_gauges:
         # recovery-event block (resilience/loop.py feed, mirrors memory:):
         # a zero-fault run shows armed-but-quiet counters at 0

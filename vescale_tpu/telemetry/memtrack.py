@@ -37,11 +37,12 @@ import json
 import os
 import threading
 import time
-import warnings
 import weakref
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
+
+from . import alerts as _alerts
 
 from .memory_report import device_memory_stats, live_array_census
 
@@ -226,6 +227,10 @@ class MemoryTracker:
         if self._last_untagged is not None and untagged > self._last_untagged:
             self._growth_run += 1
         else:
+            if self._leak_warned:
+                # growth broke: the leak episode is over — resolve the
+                # alert (a no-op while the engine is dormant)
+                _alerts.resolve("mem-leak")
             self._growth_run = 0
             self._leak_warned = False
         self._last_untagged = untagged
@@ -233,12 +238,19 @@ class MemoryTracker:
         if self._growth_run >= self.leak_steps and not self._leak_warned:
             self._leak_warned = True
             registry.counter("mem_leak_warnings_total").inc()
-            warnings.warn(
-                f"memtrack: untagged live-array bytes grew monotonically for "
-                f"{self._growth_run} consecutive steps (now {untagged} B) — "
-                "possible leak.  telemetry.dump_now() writes a tagged census "
-                "to identify the owner.",
-                stacklevel=3,
+            # the leak watcher routes through the alert engine (one
+            # lifecycle, /alerts visibility, ALERT timeline span); with the
+            # engine off this degrades to the legacy one-shot warning
+            _alerts.raise_alert(
+                "mem-leak",
+                message=(
+                    f"memtrack: untagged live-array bytes grew monotonically "
+                    f"for {self._growth_run} consecutive steps (now "
+                    f"{untagged} B) — possible leak.  telemetry.dump_now() "
+                    "writes a tagged census to identify the owner."
+                ),
+                severity="warning",
+                value=float(untagged),
             )
 
         sample = {

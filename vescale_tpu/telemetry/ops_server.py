@@ -22,6 +22,11 @@ the run.  This module serves the replica's network surface from one
                  learns completions without a push channel.
   ``/submit``    POST: one request into the replica's inbox
                  (serve/fleet.py) — the fleet router's dispatch hop.
+  ``/alerts``    the alert-engine lifecycle snapshot (telemetry/alerts.py
+                 ``payload`` — FROZEN schema v1: rule states, firing/
+                 pending sets, bounded transition history; serves the
+                 same schema with ``active: false`` while the engine is
+                 dormant, so probes need no gate awareness).
   ``/fleet``     (router-side) the aggregated fleet rollup —
                  ``FleetRouter.start_ops`` registers
                  ``serve/obs.py::FleetObservability.fleet`` on the
@@ -72,8 +77,10 @@ _LOCK = threading.Lock()
 
 # GET endpoints a provider may be registered for; /submit is the one POST.
 # /fleet is the ROUTER-side aggregate feed (serve/obs.py
-# FleetObservability — the fleet router's own OpsServer registers it).
-_GET_ENDPOINTS = ("healthz", "router", "outcomes", "fleet")
+# FleetObservability — the fleet router's own OpsServer registers it);
+# /alerts is the alert-engine lifecycle snapshot (telemetry/alerts.py
+# ``payload`` — frozen schema v1, served dormant too).
+_GET_ENDPOINTS = ("healthz", "router", "outcomes", "fleet", "alerts")
 _POST_ENDPOINTS = ("submit",)
 
 _STATUS_TEXT = {
@@ -111,7 +118,7 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, "text/plain; charset=utf-8",
                        "not found (endpoints: /metrics /healthz /router "
-                       "/outcomes /fleet /submit)\n")
+                       "/outcomes /fleet /alerts /submit)\n")
 
     def do_POST(self):  # noqa: N802 (stdlib naming)
         ops: "OpsServer" = self.server.ops  # type: ignore[attr-defined]

@@ -114,6 +114,7 @@ class StragglerDetector:
                 cell[s.rank] = min(cell.get(s.rank, t), t)
                 while len(steps) > self.window:
                     steps.popitem(last=False)
+        self.publish_alerts()
 
     def update_from_merged(self, merged: Dict[tuple, Dict]) -> None:
         """Ingest a ``parser_handler.merge_ranks`` rollup: ``{(step, metric):
@@ -127,6 +128,7 @@ class StragglerDetector:
                     )
                     dq.append(float(ms))
                     self.spans_seen += 1
+        self.publish_alerts()
 
     # ------------------------------------------------------------ queries
     def rank_means(self, metric: str) -> Dict[int, float]:
@@ -195,6 +197,29 @@ class StragglerDetector:
                     )
         out.sort(key=lambda e: e["mean_lag_ms"], reverse=True)
         return out
+
+    def publish_alerts(self) -> None:
+        """Route the straggler findings through the alert engine (one
+        lifecycle, /alerts visibility, ALERT timeline span) — the
+        previously-silent watcher's migration.  Only acts while the engine
+        is live: a dormant run keeps the old report()/summary() pull
+        model, no new warnings."""
+        from . import alerts as _alerts
+
+        if not _alerts.is_active():
+            return
+        flagged = self.report()
+        lagged = self.lag_report()
+        if flagged or lagged:
+            worst = (
+                flagged[0]["ratio"] if flagged else lagged[0]["mean_lag_ms"]
+            )
+            _alerts.raise_alert(
+                "straggler-lag", message=self.summary(), severity="warning",
+                value=float(worst),
+            )
+        else:
+            _alerts.resolve("straggler-lag")
 
     def healthy(self) -> bool:
         # both straggler shapes gate health: duration outliers AND

@@ -293,7 +293,9 @@ def make_train_step(
                 import warnings
 
                 g["warned"] = True
-                warnings.warn(
+                # a caller-contract misuse notice (fix the call site), not
+                # a runtime health signal — stays a warn-once
+                warnings.warn(  # vescale-lint: disable=VSC207
                     "make_train_step(auto_inc_step=True) advances the "
                     "ndtimeline step counter itself, but it was ALSO advanced "
                     "externally (manual inc_step() or flush(next_iteration="
@@ -330,6 +332,14 @@ def make_train_step(
                     rec["tokens_per_sec"] = tokens / dt
             if tmetrics:
                 rec.update({k: float(v) for k, v in tmetrics.items()})
+            # default train rule pack (loss anomaly, grad-norm spike,
+            # step-time regression, memory growth): armed lazily at the
+            # first live step so late telemetry.init() still gets it;
+            # arm_pack dedups by name (a set probe) on every later step
+            from .telemetry import alerts as _alerts
+
+            if _alerts.is_active():
+                _alerts.get_engine().arm_pack("train", _alerts.train_rule_pack())
             _tel.record_step(rec)
         return out
 
