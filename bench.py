@@ -335,6 +335,9 @@ def bench_memtrack():
     tracked = timed_loop(iters)
     tracker = memtrack.get_tracker()
     live = tracker.history[-1]["live_arrays"] if tracker.history else 0
+    from vescale_tpu.telemetry import costaudit
+
+    audit = costaudit.audit_summary()  # plan-vs-reality ledger state
     telemetry.shutdown()
     overhead = tracked - base
     print(json.dumps({
@@ -345,6 +348,7 @@ def bench_memtrack():
         "step_ms_base": round(base * 1e3, 3),
         "step_ms_memtrack": round(tracked * 1e3, 3),
         "live_arrays": live,
+        "audit": audit,
     }))
 
 
@@ -1173,6 +1177,107 @@ def bench_alerts():
     assert frac is not None and frac < 0.01, (frac, guard_cost, fire_cost)
 
 
+def bench_costaudit():
+    """Cost-audit overhead rung (VESCALE_BENCH=costaudit): the plan-vs-
+    reality layer's per-step price — a prediction/measurement ledger join
+    plus the ``audit_step`` harvest-and-publish that rides every
+    ``telemetry.record_step`` — expressed as a fraction of a real compiled
+    train step.
+
+    Both legs run the IDENTICAL body (record_prediction + joined
+    record_measurement + record_step): with costaudit dormant the first
+    two are the module-level no-op hooks, so the delta is exactly the
+    armed layer.  Acceptance: < 1% of the real step."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from vescale_tpu import telemetry
+    from vescale_tpu.dmodule import parallelize_module
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.models.llama import Llama, LlamaConfig, llama_plan
+    from vescale_tpu.models.nanogpt import cross_entropy_loss
+    from vescale_tpu.parallel.optimizer import DistributedOptimizer
+    from vescale_tpu.telemetry import costaudit
+    from vescale_tpu.train import make_train_step
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    B, T = (4, 1024) if on_tpu else (2, 64)
+    cfg = LlamaConfig(
+        vocab_size=2048 if on_tpu else 128,
+        hidden_size=256 if on_tpu else 32,
+        intermediate_size=512 if on_tpu else 64,
+        num_hidden_layers=4 if on_tpu else 2,
+        num_attention_heads=4 if on_tpu else 2,
+        num_key_value_heads=4 if on_tpu else 2,
+        max_position_embeddings=T,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    mesh = DeviceMesh(("dp", "tp"), (1, 1), devices=devices[:1])
+    dm = parallelize_module(Llama(cfg), mesh, llama_plan(mesh, sequence_parallel=False))
+    params = dm.init(jax.random.key(0), jnp.ones((2, T), jnp.int32))["params"]
+    dopt = DistributedOptimizer(optax.adamw(1e-3))
+    opt_state = dopt.init(params)
+    step = make_train_step(
+        dm, dopt, lambda lg, b: cross_entropy_loss(lg, b["target"]), donate=False
+    )
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T + 1)), jnp.int32)
+    batch = {"input": toks[:, :-1], "target": toks[:, 1:]}
+
+    # denominator: the real step, telemetry DORMANT
+    assert not telemetry.is_active()
+    p, s = params, opt_state
+    for _ in range(3):
+        p, s, loss = step(p, s, batch)
+    float(loss)
+    iters = 20 if on_tpu else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        p, s, loss = step(p, s, batch)
+    float(loss)
+    step_real = (time.perf_counter() - t0) / iters
+
+    def layer_loop(n, armed):
+        telemetry.init(out_dir=None, memtrack=False, timeseries=False,
+                       alerts=False, costaudit=armed)
+        try:
+            for _ in range(100):  # steady state: ledger warm, ring bounded
+                pid = costaudit.record_prediction("bench", predicted_us=100.0)
+                costaudit.record_measurement(pid, measured_us=110.0)
+                telemetry.record_step({"q": 2}, kind="train")
+            t0 = time.perf_counter()
+            for _ in range(n):
+                pid = costaudit.record_prediction("bench", predicted_us=100.0)
+                costaudit.record_measurement(pid, measured_us=110.0)
+                telemetry.record_step({"q": 2}, kind="train")
+            per = (time.perf_counter() - t0) / n
+            return per, costaudit.audit_summary()
+        finally:
+            telemetry.shutdown()
+
+    loop_iters = 20_000
+    plain = min(layer_loop(loop_iters, armed=False)[0] for _ in range(2))
+    armed_runs = [layer_loop(loop_iters, armed=True) for _ in range(2)]
+    armed = min(per for per, _ in armed_runs)
+    audit = armed_runs[-1][1]
+    cost = max(0.0, armed - plain)
+    frac = cost / step_real if step_real > 0 else None
+    assert audit is not None and audit["matched"] >= loop_iters, audit
+    print(json.dumps({
+        "metric": "costaudit_overhead_frac" if on_tpu else "costaudit_overhead_frac_cpu",
+        "value": round(frac, 6) if frac is not None else None,
+        "unit": "fraction",
+        "audit_us_per_step": round(cost * 1e6, 3),
+        "step_ms_real": round(step_real * 1e3, 3),
+        "loop_iters": loop_iters,
+        "audit": audit,
+        "acceptance_lt": 0.01,
+    }))
+    assert frac is not None and frac < 0.01, (frac, cost, step_real)
+
+
 def bench_kernels():
     """Kernel rung (VESCALE_BENCH=kernels): per-kernel kernel-vs-XLA wall
     time at 2-3 shapes plus an interpret-mode parity assertion, one JSON
@@ -1563,6 +1668,8 @@ def _dispatch():
         bench_serve()
     elif which == "alerts":
         bench_alerts()
+    elif which == "costaudit":
+        bench_costaudit()
     elif which == "elastic":
         bench_elastic()
     elif which == "kernels":

@@ -28,6 +28,10 @@ echo "=== fleet trace smoke (kill+rejoin battery -> ONE stitched fleet timeline,
 python scripts/fleet_trace_smoke.py || failed=1
 echo "=== alert smoke (slow_decode fault -> burn-rate rule pending->firing->resolved on the live /alerts endpoint)"
 python scripts/alert_smoke.py || failed=1
+echo "=== cost-audit smoke (skewed table -> drift fires -> recalibration self-heals the plan; serve joins; dormant bit-identical)"
+python scripts/costaudit_smoke.py || failed=1
+echo "=== what-if CLI smoke (audited (dp,tp,pp) re-scoring)"
+python -m vescale_tpu.analysis whatif --devices 8 --top 3 || failed=1
 for f in tests/test_*.py; do
   echo "=== $f"
   python -m pytest "$f" -q || failed=1

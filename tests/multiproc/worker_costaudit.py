@@ -1,0 +1,123 @@
+"""2-process cost-audit worker (ISSUE 18 satellite): divergence-driven
+replan across a real process boundary.
+
+A deliberately skewed calibration table prices ``all_gather`` at ~1ns, so
+the redistribution planner routes Shard(0) -> Shard(1) through the cheap-
+by-lie gather route.  Executing the plan runs the AUDITED hop chain: real
+gloo wall times join the prediction ledger (divergence blows past the
+threshold and ``cost-model-drift`` fires), the tagged hop spans are
+harvested back into the table, the digest rotates, and the next plan
+lookup misses the cache and re-plans onto the honest direct all_to_all
+path.  Both ranks must observe the full loop; values stay bit-exact
+throughout.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import vescale_tpu.distributed as vdist  # noqa: E402
+
+vdist.initialize()
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+import vescale_tpu as vt  # noqa: E402
+from vescale_tpu import telemetry  # noqa: E402
+from vescale_tpu.mesh import DeviceMesh  # noqa: E402
+from vescale_tpu.ndtimeline import api as nd  # noqa: E402
+from vescale_tpu.placements import Shard  # noqa: E402
+from vescale_tpu.redistribute_plan import (  # noqa: E402
+    clear_plan_cache,
+    plan_redistribute,
+)
+from vescale_tpu.spec import DArraySpec, TensorMeta  # noqa: E402
+from vescale_tpu.telemetry import calibrate as cal  # noqa: E402
+from vescale_tpu.telemetry import costaudit  # noqa: E402
+
+me = vdist.process_index()
+assert vdist.process_count() == 2
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+# dormant identity: before telemetry arms anything, the hot hooks ARE the
+# module-level no-ops and a prediction simply disappears
+assert costaudit.record_prediction is costaudit._noop_record_prediction
+assert costaudit.record_measurement is costaudit._noop_record_measurement
+assert costaudit.record_prediction("x", predicted_us=1.0) is None
+
+mesh = DeviceMesh(("x",), (8,))  # spans both processes
+shape = (2048, 2048)  # 16 MiB f32; per-shard 2 MiB = an exact bucket
+
+# the skew: all_gather at 8 ranks / 2 MiB lied down to ~1ns, so the
+# gather route beats the analytically-priced direct all_to_all
+table = cal.CalibrationTable()
+table.add_sample("all_gather", 8, 2 * 1024 * 1024, 1e-9)
+table.meta = {"platform": "cpu", "mesh": {"dim_names": ["x"], "shape": [8]}}
+cal.set_active(table)
+digest0 = cal.active_digest()
+assert digest0 is not None
+
+nd.init_ndtimers(rank=me)
+telemetry.init(out_dir=None, memtrack=False)
+assert costaudit.is_active()
+eng = telemetry.get_state().alerts
+assert eng is not None
+
+clear_plan_cache()
+meta = TensorMeta(shape, jnp.dtype(jnp.float32))
+src = DArraySpec(mesh, vt.normalize_placements([Shard(0)], 1, 2), meta)
+dst = DArraySpec(mesh, vt.normalize_placements([Shard(1)], 1, 2), meta)
+
+plan1 = plan_redistribute(src, dst)
+assert plan1 is not None and plan1.plan_id is not None
+assert len(plan1.hops) >= 2, [h.kind for h in plan1.hops]
+assert any("all_gather" in h.collectives for h in plan1.hops), (
+    "skewed table should route via the gather hop"
+)
+
+xnp = np.arange(shape[0] * shape[1], dtype=np.float32).reshape(shape)
+g = jax.make_array_from_callback(
+    shape, NamedSharding(mesh.jax_mesh, P("x", None)), lambda idx: xnp[idx]
+)
+out = plan1.execute(g)  # audited chain: measured spans + ledger join
+assert out.sharding.spec == P(None, "x"), out.sharding.spec
+for sh in out.addressable_shards:
+    np.testing.assert_array_equal(np.asarray(sh.data), xnp[sh.index])
+
+# the step boundary joins the ledger, harvests the hop spans, publishes
+# the divergence gauges and evaluates the drift rule (cadence + eval
+# interval are pinned to 0 by the spawning test)
+telemetry.record_step({"loss": 1.0}, kind="train")
+
+summ = costaudit.audit_summary()
+assert summ["matched"] >= 1, summ
+assert summ["divergence"] > 3.0, summ  # gloo ms vs the ~ns lie
+assert summ["harvested_spans"] >= 1, summ
+assert summ["digest_rotations"] >= 1, summ
+assert "cost-model-drift" in eng.firing(), eng.firing()
+
+digest1 = cal.active_digest()
+assert digest1 != digest0
+corrected = table.lookup_us("all_gather", 8, 2 * 1024 * 1024)
+assert corrected is not None and corrected > 1e3  # folded toward real ms
+
+# self-heal: the rotated digest misses the plan cache; the fresh search
+# prices the gather route at its MEASURED cost and picks the direct path
+plan2 = plan_redistribute(src, dst)
+assert plan2 is not None and plan2 is not plan1
+assert len(plan2.hops) == 1, [h.kind for h in plan2.hops]
+assert not any("all_gather" in h.collectives for h in plan2.hops)
+
+out2 = plan2.execute(g)
+for sh in out2.addressable_shards:
+    np.testing.assert_array_equal(np.asarray(sh.data), xnp[sh.index])
+
+telemetry.shutdown()
+cal.reset_active()
+print(f"OK proc {me}")
+sys.stdout.flush()

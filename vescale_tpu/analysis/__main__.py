@@ -15,6 +15,10 @@ Commands (default with no command: ``lint`` + ``examples``):
                        pair the multi-hop planner declines (VSC106 +
                        VSC12x decline code); ``good`` is the clean twin
   envdoc [--write P]   print (or write) the generated configuration doc
+  whatif [...]         re-score candidate (dp, tp, pp) meshes against the
+                       audited calibration table (telemetry/costaudit.py):
+                       predicted step time + audit-backed confidence per
+                       layout
 
 Flags: ``--strict`` fails (exit 1) on warning-severity findings too (and
 is how CI gates); ``--json`` emits machine-readable reports.
@@ -162,6 +166,52 @@ def cmd_examples(args) -> List[FindingReport]:
     return reports
 
 
+def cmd_whatif(args) -> int:
+    """Re-score candidate (dp, tp, pp) meshes against the live audited
+    calibration table (telemetry/costaudit.py) — predicted step time per
+    layout plus audit-backed confidence per collective term."""
+    from ..telemetry import costaudit
+    from ..telemetry.calibrate import load_table, set_active
+
+    if args.table:
+        set_active(load_table(args.table))
+    num = args.devices
+    if not num:
+        import jax
+
+        num = len(jax.devices())
+    device = None
+    if args.device:
+        # a named generation ("v5p", "v6e", ...) instead of the local chip:
+        # a shim carrying just the two attrs device_peak_flops reads
+        device = type("_Dev", (), {"device_kind": args.device,
+                                   "platform": "tpu"})()
+    cands = costaudit.mesh_candidates(num)
+    ranked = costaudit.score_candidates(
+        cands,
+        params_bytes=args.params_bytes,
+        activation_bytes=args.activation_bytes,
+        flops_per_step=args.flops,
+        device=device,
+    )
+    if args.top:
+        ranked = ranked[: args.top]
+    if args.json:
+        print(json.dumps({"num_devices": num, "candidates": ranked}, indent=2))
+        return 0
+    print(f"what-if plan scores over {num} devices "
+          f"({len(cands)} (dp, tp, pp) layouts):")
+    print(f"  {'mesh':>14} {'step_us':>12} {'compute_us':>12} "
+          f"{'comm_us':>10} {'conf':>5}  sources")
+    for r in ranked:
+        m = r["mesh"]
+        srcs = ",".join(sorted({t["source"] for t in r["terms"]})) or "-"
+        print(f"  ({m['dp']:>3},{m['tp']:>3},{m['pp']:>3}) "
+              f"{r['predicted_step_us']:>12.1f} {r['compute_us']:>12.1f} "
+              f"{r['comm_us']:>10.1f} {r['confidence']:>5.2f}  {srcs}")
+    return 0
+
+
 def cmd_envdoc(args) -> List[FindingReport]:
     from .envreg import configuration_markdown
 
@@ -188,6 +238,22 @@ def main(argv=None) -> int:
     p_demo.add_argument("which", choices=("good", "bad"))
     p_env = sub.add_parser("envdoc", help="generated configuration doc")
     p_env.add_argument("--write", default=None, metavar="PATH")
+    p_wi = sub.add_parser(
+        "whatif", help="re-score candidate (dp, tp, pp) meshes against the "
+        "audited calibration table")
+    p_wi.add_argument("--devices", type=int, default=0,
+                      help="world size (default: local device count)")
+    p_wi.add_argument("--params-bytes", type=float, default=1e9)
+    p_wi.add_argument("--activation-bytes", type=float, default=1e8)
+    p_wi.add_argument("--flops", type=float, default=1e12,
+                      help="model FLOPs per step")
+    p_wi.add_argument("--table", default=None, metavar="PATH",
+                      help="calibration table JSON (default: active table)")
+    p_wi.add_argument("--device", default=None,
+                      help='chip generation for the compute roofline '
+                      '(e.g. "v5p"; default: local device)')
+    p_wi.add_argument("--top", type=int, default=0,
+                      help="print only the best N layouts")
     args = ap.parse_args(argv)
 
     if args.cmd == "lint":
@@ -199,6 +265,8 @@ def main(argv=None) -> int:
     elif args.cmd == "envdoc":
         cmd_envdoc(args)
         return 0
+    elif args.cmd == "whatif":
+        return cmd_whatif(args)
     else:
         args.paths = None
         reports = cmd_lint(args) + cmd_examples(args)

@@ -373,6 +373,20 @@ register("VESCALE_ALERTS_BURN_WINDOWS", "str", None,
 register("VESCALE_ALERTS_BURN_FOR_S", "float", 0.0,
          "Hold seconds before a burn-rate rule transitions pending -> firing (0 = fire on first evaluation where both windows burn).")
 
+# --- cost audit (plan-vs-reality) ------------------------------------
+register("VESCALE_COSTAUDIT", "bool", True,
+         "Arm the plan-vs-reality cost auditor at telemetry.init(): priced plans (redistribution, quant edges, pipe schedules, AOT budgets, serve steps) ledger their predictions, a per-step join publishes `cost_model_*` divergence gauges + the `cost-model-drift` rule, and the online harvest folds measured spans back into the calibration table; off = the hooks stay dormant no-op references (docs/observability.md).")
+register("VESCALE_COSTAUDIT_DEPTH", "int", 256,
+         "Bounded prediction-ledger ring depth — oldest predictions fall off once this many are outstanding (late measurements against an evicted plan id are ignored).")
+register("VESCALE_COSTAUDIT_THRESHOLD", "float", 3.0,
+         "Divergence ratio (decayed mean of max(measured/predicted, predicted/measured)) above which the `cost-model-drift` alert rule fires.")
+register("VESCALE_COSTAUDIT_DECAY", "float", 0.25,
+         "EWMA weight of the online calibration harvest and the divergence aggregates: each measured span moves its table bucket this fraction of the way to the new wall time (the sweep's plain 1/n running mean is unchanged).")
+register("VESCALE_COSTAUDIT_CADENCE_S", "float", 30.0,
+         "Minimum seconds between atomic persists of the harvested calibration table to the VESCALE_COST_CALIBRATION path (no path = no persistence; digest rotation still re-plans in-process).")
+register("VESCALE_COSTAUDIT_HARVEST", "bool", True,
+         "Let the per-step auditor harvest tagged ndtimeline spans into the active calibration table (online recalibration); off = audit-only (divergence is reported but the table never moves).")
+
 # --- bench harness ---------------------------------------------------
 register("VESCALE_BENCH", "str", None,
          "Which bench rung to run (e.g. `serve`, `redistribute`, `memtrack`, `watchdog`); unset = default MFU line.")

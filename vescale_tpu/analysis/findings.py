@@ -125,6 +125,8 @@ _CODE_DEFS: Tuple[Tuple[str, Severity, str], ...] = (
      "direct pallas_call outside vescale_tpu/kernels (kernel dispatch contract)"),
     ("VSC207", Severity.WARNING,
      "ad-hoc warn-once latch outside the alert engine (telemetry/alerts.py)"),
+    ("VSC208", Severity.WARNING,
+     "priced decision (simulate_schedule/estimate_stage_costs) without a cost-audit record_prediction"),
 )
 
 CODES: Dict[str, FindingCode] = {

@@ -34,6 +34,15 @@ Five rules, each a lesson this codebase already paid for once:
           itself (telemetry/alerts.py owns the ONE sanctioned fallback
           latch) is exempt.
 
+  VSC208  a priced decision must enter the cost-audit ledger: PACKAGE
+          code (files under vescale_tpu/ — tests, scripts and bench
+          call the cost model to inspect it, not to decide) that calls
+          ``simulate_schedule``/``estimate_stage_costs`` inside a
+          function with no ``record_prediction`` reference is choosing
+          by a prediction nobody will ever audit against reality
+          (telemetry/costaudit.py).  Record the prediction, or annotate
+          the site.
+
 Plus VSC104 (shared with shardcheck): collective calls under
 rank-divergent ``if``/``while`` conditions — the classic SPMD deadlock.
 
@@ -85,6 +94,11 @@ _COLLECTIVE_CALLS = {
 # rank-guarded SINGLE-WRITER idioms that are fine (no collective inside)
 _CALLS_EXEMPT_FROM_RANK_GUARD: Set[str] = set()
 
+# cost-model entry points whose callers are PRICING a decision (VSC208):
+# a package function that ranks/chooses by these without recording the
+# prediction produces a cost nobody ever audits
+_PRICED_CALLS = {"simulate_schedule", "estimate_stage_costs"}
+
 
 def _disabled_codes(lines: Sequence[str], lineno: int) -> Set[str]:
     if 1 <= lineno <= len(lines):
@@ -115,6 +129,10 @@ class _Lint(ast.NodeVisitor):
             for a, b in zip(parts, parts[1:])
         )
         self._vsc207_seen: Set[int] = set()
+        self._vsc208_seen: Set[int] = set()
+        # VSC208 applies only to package code: tests/scripts/bench call
+        # the cost model to inspect it, not to decide by it
+        self._in_package = "vescale_tpu" in parts
         # exempt ONLY the vescale_tpu/kernels package itself — a nested
         # .../kernels/ directory elsewhere is still subject to VSC206
         self._in_kernels = any(
@@ -275,9 +293,49 @@ class _Lint(ast.NodeVisitor):
                     call,
                 )
 
+    # ------------------------------------------------------------- VSC208
+    def _check_priced_decision(self, node: ast.FunctionDef) -> None:
+        """A package function that calls a cost-model entry point but never
+        references ``record_prediction`` is pricing a decision outside the
+        audit ledger.  The finding anchors to the priced call; a function
+        that records (or a delegating wrapper that does) is clean by the
+        same reference check."""
+        if not self._in_package:
+            return
+        priced = []
+        has_record = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = _dotted(sub.func).rsplit(".", 1)[-1]
+                if name in _PRICED_CALLS:
+                    priced.append((name, sub))
+            ident = None
+            if isinstance(sub, ast.Name):
+                ident = sub.id
+            elif isinstance(sub, ast.Attribute):
+                ident = sub.attr
+            if ident == "record_prediction":
+                has_record = True
+        if has_record:
+            return
+        for name, call in priced:
+            if id(call) in self._vsc208_seen:
+                continue
+            self._vsc208_seen.add(id(call))
+            self.emit(
+                "VSC208",
+                f"`{name}` priced a decision in {node.name!r} with no "
+                "record_prediction in scope — the prediction never enters "
+                "the cost-audit ledger (telemetry/costaudit.py), so it can "
+                "never be checked against reality; record it or annotate "
+                "the site",
+                call,
+            )
+
     # ------------------------------------------------------------- VSC204
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._check_warn_latch(node)
+        self._check_priced_decision(node)
         if node.name in self._handler_names:
             for sub in ast.walk(node):
                 if isinstance(sub, ast.Call):

@@ -474,7 +474,9 @@ def _zb_cost_schedule_cached(
                 )
         else:
             cands.append(zero_bubble_schedule(num_stages, num_microbatches))
-    return min(cands, key=lambda sch: simulate_schedule(sch, costs))
+    # prediction recording lives in the public wrapper (this fn is
+    # lru-cached: recording here would skip cache hits)
+    return min(cands, key=lambda sch: simulate_schedule(sch, costs))  # vescale-lint: disable=VSC208
 
 
 def zero_bubble_cost_schedule(
@@ -507,6 +509,24 @@ def zero_bubble_cost_schedule(
     cached = _zb_cost_schedule_cached(
         num_stages, num_microbatches, costs, virtual_chunks, max_inflight
     )
+    from ..telemetry import costaudit as _ca
+
+    if _ca.is_active():
+        # ledger the chosen schedule's simulated makespan — units follow
+        # the StageCosts the caller priced in (µs when they came from a
+        # calibrated estimate_stage_costs, abstract cost units otherwise,
+        # which the auditor counts but never computes divergence over)
+        from ..telemetry import calibrate as _cal
+
+        digest = _cal.active_digest()
+        _ca.record_prediction(
+            "pipe_schedule",
+            predicted_us=simulate_schedule(cached, costs) if digest is not None else None,
+            digest=digest,
+            unit="us" if digest is not None else "cost",
+            detail={"stages": num_stages, "microbatches": num_microbatches,
+                    "virtual_chunks": virtual_chunks},
+        )
     return [list(stage) for stage in cached]  # callers may mutate their copy
 
 
@@ -561,9 +581,20 @@ def estimate_stage_costs(
                 from .. import collectives as C
 
                 comm_us = C.analytic_cost_us("ppermute", (act_bytes or 0) / 1e9, n)
-            return StageCosts.from_weights(
+            sc = StageCosts.from_weights(
                 [w * us_per_flop for w in weights], comm=comm_us
             )
+            from ..telemetry import costaudit as _ca
+
+            # µs-denominated stage costs are a priced plan: ledger the
+            # total so the auditor can join a measured pipeline step
+            _ca.record_prediction(
+                "pipe_stage_costs",
+                predicted_us=sum(sc.f) + sum(sc.bd) + sum(sc.w),
+                digest=table.digest(), unit="us",
+                detail={"stages": len(sc.f), "comm_us": comm_us},
+            )
+            return sc
         comm = 0.0  # no usable table: legacy FLOP units
     return StageCosts.from_weights(weights, comm=comm)
 

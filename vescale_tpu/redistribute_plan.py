@@ -260,6 +260,10 @@ class RedistributePlan:
     src: DArraySpec
     dst: DArraySpec
     hops: Tuple[PlanHop, ...]
+    # cost-audit ledger id of the prediction this plan's price recorded
+    # (telemetry/costaudit.py); None when the auditor was dormant at
+    # planning time
+    plan_id: Optional[int] = None
 
     @property
     def bytes_moved(self) -> int:
@@ -272,12 +276,20 @@ class RedistributePlan:
     def execute(self, physical):
         """Run the hop chain on a physical(src) array; feeds the telemetry
         plan counters/gauge from the SAME summary comm_mode attribution
-        reads (plan_comm_summary) so the two views cannot diverge."""
+        reads (plan_comm_summary) so the two views cannot diverge.  With
+        the cost auditor live and a ledgered price, the chain runs
+        measured instead: per-hop synchronized spans tagged with the
+        calibrate harvest contract, and the wall time joined back to the
+        prediction."""
         from . import telemetry as _tel
+        from .telemetry import costaudit as _ca
 
         x = physical
-        for hop in self.hops:
-            x = hop.apply(x)
+        if self.plan_id is not None and _ca.is_active():
+            x = self._execute_measured(x, _ca)
+        else:
+            for hop in self.hops:
+                x = hop.apply(x)
         if _tel.is_active():
             summary = plan_comm_summary(self)
             _tel.count("redistribute.hops", len(self.hops))
@@ -290,6 +302,47 @@ class RedistributePlan:
                     "grad_compress_bytes_saved_total",
                     sum(max(0, h.bytes_raw - h.bytes_moved) for h in qhops),
                 )
+        return x
+
+    def _execute_measured(self, x, _ca):
+        """Audited hop chain: each hop runs synchronized inside an
+        ndtimeline span carrying the calibrate SPAN_TAGS contract (so the
+        online harvest folds the measured wall time back into the table)
+        plus the plan id; the chain total joins the ledger.  The per-hop
+        ``block_until_ready`` is the price of honest wall times — audited
+        mode opts into it; the dormant path is untouched."""
+        import time as _time
+
+        from .ndtimeline.api import ndtimeit
+
+        t0 = _time.perf_counter()
+        for hop in self.hops:
+            op = None
+            if hop.collectives:
+                wire = max(hop.collectives.items(), key=lambda kv: kv[1])[0]
+                op = _CAL_OP.get(wire, (wire, 1.0))[0]
+            elif hop.kind in _CAL_OP:
+                op = _CAL_OP[hop.kind][0]
+            if op is None:  # slice/seed-only hop: no wire time to harvest
+                x = hop.apply(x)
+                continue
+            sb, db = hop.src.per_shard_bytes(), hop.dst.per_shard_bytes()
+            # per-rank OPERAND payload, matching the bucket the planner's
+            # measured lookup reads (a gather is keyed by its source shard)
+            payload = sb if op in ("all_gather", "reduce_scatter") else max(sb, db)
+            with ndtimeit(
+                "redistribute-hop",
+                tags={
+                    "collective_op": op,
+                    "axis_size": _edge_fanin(hop.src, hop.dst),
+                    "bytes": int(payload),
+                    "plan_id": self.plan_id,
+                },
+            ):
+                x = jax.block_until_ready(hop.apply(x))
+        _ca.record_measurement(
+            self.plan_id, measured_us=(_time.perf_counter() - t0) * 1e6
+        )
         return x
 
 
@@ -747,11 +800,31 @@ def _record_quant_outcome(key, src: DArraySpec, dst: DArraySpec, plan) -> None:
     _QUANT_DECLINES.put(key, Decline("VSC127", reason))
 
 
+def _record_plan_prediction(plan: RedistributePlan, kind: str = "redistribute"):
+    """Ledger one priced plan with the cost auditor: µs-denominated under
+    a calibrated table (``total_cost`` IS microseconds then), weighted-
+    bytes otherwise — the auditor only computes divergence for µs plans,
+    so the analytic mode stays audit-visible without fake units.  Returns
+    the plan id (None while the auditor is dormant)."""
+    from .telemetry import costaudit as _ca
+
+    digest = _cal_key()
+    return _ca.record_prediction(
+        kind,
+        predicted_us=plan.total_cost if digest is not None else None,
+        predicted_bytes=plan.bytes_moved,
+        digest=digest,
+        unit="us" if digest is not None else "weighted_bytes",
+        detail={"hops": len(plan.hops), "kinds": [h.kind for h in plan.hops]},
+    )
+
+
 def plan_redistribute(src: DArraySpec, dst: DArraySpec) -> Optional[RedistributePlan]:
     """A memoized multi-hop plan for src -> dst, or None (reason retrievable
     via ``decline_reason``).  Consulted by ``redistribute()`` only after the
     single-hop kernels decline."""
     from . import telemetry as _tel
+    from .telemetry import costaudit as _ca
 
     # the knobs are part of the key: raising VESCALE_REDISTRIBUTE_MEM_FACTOR
     # after a budget decline (as the fallback warning instructs) must
@@ -760,6 +833,10 @@ def plan_redistribute(src: DArraySpec, dst: DArraySpec) -> Optional[Redistribute
     plan = _PLANS.get(key)
     if plan is not None:
         _tel.count("redistribute.plan_hits")
+        if plan.plan_id is None and _ca.is_active():
+            # planned while the auditor was dormant (or under a now-dead
+            # auditor whose ring dropped it): re-ledger the cached price
+            plan.plan_id = _record_plan_prediction(plan)
         return plan
     reason = _DECLINES.get(key)
     if reason is not None:
@@ -775,6 +852,7 @@ def plan_redistribute(src: DArraySpec, dst: DArraySpec) -> Optional[Redistribute
     if plan is None:
         _DECLINES.put(key, reason or Decline("VSC121", "unknown"))
         return None
+    plan.plan_id = _record_plan_prediction(plan)
     _PLANS.put(key, plan)
     return plan
 
@@ -805,8 +883,11 @@ def quant_single_hop_plan(src: DArraySpec, dst: DArraySpec) -> Optional[Redistri
     plan = _PLANS.get(key)
     if plan is not None:
         from . import telemetry as _tel
+        from .telemetry import costaudit as _ca
 
         _tel.count("redistribute.plan_hits")
+        if plan.plan_id is None and _ca.is_active():
+            plan.plan_id = _record_plan_prediction(plan, kind="redistribute_quant")
         return plan if any(h.kind == "quant" for h in plan.hops) else None
     if key in _QUANT_DECLINES:
         return None
@@ -814,6 +895,7 @@ def quant_single_hop_plan(src: DArraySpec, dst: DArraySpec) -> Optional[Redistri
     d = _dense_edge(src, dst, build=False)
     if q is not None and (d is None or q.cost < d.cost):
         plan = RedistributePlan(src, dst, (_quant_edge(src, dst, build=True),))
+        plan.plan_id = _record_plan_prediction(plan, kind="redistribute_quant")
         _PLANS.put(key, plan)
         return plan
     _record_quant_outcome(key, src, dst, None)

@@ -155,6 +155,7 @@ def run_serve_resilient(
     from .. import telemetry as _tel
     from ..analysis import envreg
     from ..ndtimeline import api as _nd
+    from ..telemetry import costaudit as _ca
     from ..telemetry import ops_server as _ops
 
     if not _fs.is_armed():
@@ -361,7 +362,8 @@ def run_serve_resilient(
             _sample(inf.slot, tok)
             now = time.perf_counter()
             prefill_s = now - inf.admit_wall
-            reqtrace.prefill(inf.req.rid, inf.slot, prefill_s)
+            reqtrace.prefill(inf.req.rid, inf.slot, prefill_s,
+                             tokens=len(inf.req.prompt))
             # cold-start retry seed: the first prefill wall time is the
             # first measured bound on a step of this model (conservative —
             # a decode step is cheaper than a full prefill)
@@ -530,6 +532,12 @@ def run_serve_resilient(
                 if _fs.fires("slow_decode", ctx=f"serve_step{step}"):
                     time.sleep(envreg.get_float("VESCALE_FAULTSIM_SLOW_DECODE_S"))
                 _beat(step, "decode")
+                # cost-audit prediction BEFORE the step runs (and before
+                # observe_step_time folds the measurement into the very
+                # estimator the prediction came from)
+                predicted_step_s = (
+                    scheduler.step_time_estimate() if _ca.is_active() else None
+                )
                 t0 = time.perf_counter()
                 # last sampled token of each active slot feeds this step
                 tokens = [0] * cache.num_slots
@@ -601,6 +609,12 @@ def run_serve_resilient(
                     if rate is not None:
                         _tel.set_gauge("serve_spec_accept_rate", rate)
                 dt = time.perf_counter() - t0
+                if predicted_step_s is not None:
+                    pid = _ca.record_prediction(
+                        "serve_step", predicted_us=predicted_step_s * 1e6,
+                        detail={"active": len(active_slots)},
+                    )
+                    _ca.record_measurement(pid, measured_us=dt * 1e6)
                 scheduler.observe_step_time(dt)
                 # the batched step's wall time IS each active slot's
                 # inter-token latency: one ITL observation + one

@@ -220,16 +220,23 @@ class ServeObservability:
         return self._peak
 
     def calibrated_step_estimate(self) -> Optional[float]:
-        """Decode-step seconds estimated from the compiled program's FLOPs
-        and the calibration table's measured ``matmul_gflops`` — the
+        """Decode-step seconds estimated from the calibration table — the
         scheduler's cold-start ``retry_after_s`` seed when a table is armed
-        (before even the first prefill has run)."""
+        (before even the first prefill has run).  Prefers MEASURED
+        ``serve_decode`` buckets (harvested from a prior run's tagged decode
+        spans by the cost auditor — audited, not modeled), falling back to
+        the analytic compiled-FLOPs / measured-``matmul_gflops`` estimate."""
         from ..telemetry.calibrate import active_table
 
         t = active_table()
-        g = t.meta.get("matmul_gflops") if t is not None else None
-        if not g:
+        if t is None:
             return None  # checked FIRST: no table means no extra compile
+        us = t.op_estimate_us("serve_decode")
+        if us is not None:
+            return float(us) / 1e6
+        g = t.meta.get("matmul_gflops")
+        if not g:
+            return None
         flops = self._flops()
         if not flops:
             return None

@@ -117,25 +117,37 @@ def queue_wait(rid: int, slot: int, wait_s: float, replays: int = 0) -> None:
     )
 
 
-def prefill(rid: int, slot: int, dur_s: float) -> None:
+def prefill(rid: int, slot: int, dur_s: float, tokens: Optional[int] = None) -> None:
+    """``tokens`` (the prompt length) additionally stamps the calibrate
+    harvest contract (``collective_op``/``axis_size``/``bytes``) so the
+    cost auditor folds measured prefill wall times into the calibration
+    table keyed by prompt size — the serve side's feed into online
+    calibration."""
     if not is_active():
         return
     now = time.time()
-    _record(
-        _p.SERVE_PREFILL, now - dur_s, dur_s,
-        {"rid": rid, "slot": slot, "stage": slot},
-    )
+    tags = {"rid": rid, "slot": slot, "stage": slot}
+    if tokens is not None:
+        tags.update(collective_op="serve_prefill", axis_size=2,
+                    bytes=max(1, int(tokens)))
+    _record(_p.SERVE_PREFILL, now - dur_s, dur_s, tags)
 
 
 def decode_step(step: int, dur_s: float, active: int) -> None:
     """One span per batched decode step (host lane, no slot tag) — the
-    per-step rollup and critical path read this one."""
+    per-step rollup and critical path read this one.  Also carries the
+    calibrate harvest contract keyed by batch width, so the audited table
+    learns measured decode step times (``serve_decode`` buckets — the
+    scheduler's ``retry_after_s`` seed and drafter-depth hints read the
+    rollup via ``CalibrationTable.op_estimate_us``)."""
     if not is_active():
         return
     now = time.time()
     _record(
         _p.SERVE_DECODE_STEP, now - dur_s, dur_s,
-        {"serve_step": step, "active": active},
+        {"serve_step": step, "active": active,
+         "collective_op": "serve_decode", "axis_size": max(2, int(active)),
+         "bytes": max(1, int(active))},
     )
 
 
@@ -153,13 +165,18 @@ def decode_token(rid: int, slot: int, index: int, dur_s: float) -> None:
 
 def draft(step: int, k: int, dur_s: float, active: int) -> None:
     """The drafter's k sequential proposal steps for one decode iteration
-    (host lane, like serve-decode-step — speculative decoding only)."""
+    (host lane, like serve-decode-step — speculative decoding only).
+    Carries the calibrate harvest contract keyed by DEPTH (``bytes`` = k):
+    the audited ``serve_draft`` buckets let ``speculative.suggested_k``
+    price a draft launch against a measured decode step."""
     if not is_active():
         return
     now = time.time()
     _record(
         _p.SERVE_DRAFT, now - dur_s, dur_s,
-        {"serve_step": step, "k": k, "active": active},
+        {"serve_step": step, "k": k, "active": active,
+         "collective_op": "serve_draft", "axis_size": max(2, int(active)),
+         "bytes": max(1, int(k))},
     )
 
 

@@ -206,6 +206,14 @@ class ContinuousBatchingScheduler:
         if self._step_time_seed is None and self._step_time.count == 0:
             self._step_time_seed = max(float(seconds), 1e-4)
 
+    def step_time_estimate(self) -> Optional[float]:
+        """The scheduler's current best guess at the next decode step's wall
+        time (seconds): observed p50, else the cold-start seed, else None.
+        The serve loop records it as the per-step cost-audit prediction the
+        measured wall time is joined against."""
+        p50 = self._step_time.percentile(0.5)
+        return p50 if p50 is not None else self._step_time_seed
+
     def retry_after_s(self) -> float:
         """Backpressure hint: how long until a shed client plausibly finds
         room — queue depth x observed decode-step p50.  Cold start (no

@@ -48,7 +48,41 @@ __all__ = [
     "drafter_template",
     "load_drafter_params",
     "slice_drafter_params",
+    "suggested_k",
 ]
+
+
+def suggested_k(table=None) -> Optional[int]:
+    """Drafter-depth hint from the AUDITED calibration table.
+
+    Serve runs harvest their tagged spans into the active table
+    (telemetry/costaudit.py): ``serve_decode`` buckets hold measured decode
+    step wall times and ``serve_draft`` buckets hold measured draft-phase
+    times keyed by depth (``bytes`` = k, so each sample prices k+1 drafter
+    launches).  The hint is the deepest k whose draft phase — at the
+    measured per-launch cost — stays under HALF a measured decode step,
+    clamped to [1, 8].  Returns None when the table lacks serve
+    measurements; callers then still require an explicit ``VESCALE_SPEC_K``.
+    """
+    from ..telemetry.calibrate import active_table
+
+    t = table if table is not None else active_table()
+    if t is None:
+        return None
+    decode_us = t.op_estimate_us("serve_decode")
+    if not decode_us:
+        return None
+    total = weight = 0.0
+    for (op, _axis, bucket), cell in t.entries.items():
+        if op == "serve_draft" and bucket >= 1:
+            total += cell["us"] / (bucket + 1) * cell["samples"]
+            weight += cell["samples"]
+    if not weight:
+        return None
+    per_launch = total / weight
+    if per_launch <= 0:
+        return None
+    return max(1, min(8, int(decode_us / (2.0 * per_launch)) - 1))
 
 
 def drafter_config(config, layers: int):
@@ -127,6 +161,12 @@ class SpeculativeDecoder:
 
         if k is None:
             k = envreg.get_int("VESCALE_SPEC_K")
+        if not k or k < 1:
+            # audited-table drafter-depth hint: measured serve_draft /
+            # serve_decode buckets (from a prior run's harvest) pick k when
+            # neither the caller nor the env did; absent serve measurements
+            # the explicit-k requirement stands
+            k = suggested_k()
         if not k or k < 1:
             raise ValueError(f"speculative k must be >= 1, got {k}")
         if drafter_layers is None:

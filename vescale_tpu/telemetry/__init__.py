@@ -22,13 +22,20 @@ Three observability signals, one pipeline (docs/observability.md):
      that re-prices the redistribution planner, the quant-edge competition
      and ``simulate_schedule`` from wall-clock data
      (``VESCALE_COST_CALIBRATION``).
+  6. **Plan-vs-reality cost auditing** (costaudit.py): a bounded
+     prediction ledger every priced plan records into, a per-step
+     predicted-vs-measured join publishing ``cost_model_*`` divergence
+     gauges + the ``cost-model-drift`` rule, online calibration harvest
+     (measured spans fold back into the table, digest rotation re-plans),
+     per-layer roofline attribution and the what-if mesh scorer
+     (``VESCALE_COSTAUDIT``).
 
 Gating contract (same as ndtimeline): a run that never calls
 ``telemetry.init()`` pays zero overhead — no registry, no locks, no files,
 no tag registry (the memtrack hooks are no-op function references).
 """
 
-from . import calibrate, memtrack, ops_server, trace
+from . import calibrate, costaudit, memtrack, ops_server, trace
 from .api import (
     count,
     dashboard,
@@ -78,6 +85,7 @@ __all__ = [
     "memtrack",
     "trace",
     "calibrate",
+    "costaudit",
     "ops_server",
     "flight_recorder",
     "dump_now",

@@ -26,6 +26,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from . import alerts as _alerts
+from . import costaudit as _costaudit
 from . import memtrack as _memtrack
 from . import timeseries as _timeseries
 from .exporters import JsonlExporter, dashboard as _dashboard, prometheus_text
@@ -67,6 +68,7 @@ class TelemetryState:
         self.memtrack = None  # set by init() when memory tracking is on
         self.timeseries = None  # set by init() when the history store is on
         self.alerts = None  # set by init() when the alert engine is on
+        self.costaudit = None  # set by init() when cost auditing is on
         self.last_step_report: Optional[Dict] = None  # flight-recorder feed
         if jsonl and out_dir is not None:
             os.makedirs(out_dir, exist_ok=True)
@@ -88,6 +90,7 @@ def init(
     timeseries: Optional[bool] = None,
     timeseries_cadence_s: Optional[float] = None,
     alerts: Optional[bool] = None,
+    costaudit: Optional[bool] = None,
 ) -> TelemetryState:
     """Activate telemetry.  ``out_dir=None`` keeps everything in-memory
     (registry only — no JSONL stream, no report files).  Re-initializing
@@ -105,7 +108,14 @@ def init(
     ``VESCALE_ALERTS`` knobs, both on) also activate the metric history
     store (timeseries.py) and the SLO alert engine (alerts.py) — the
     engine evaluates over the store, so ``alerts`` implies nothing
-    without ``timeseries`` except manual (code-raised) alerts."""
+    without ``timeseries`` except manual (code-raised) alerts.
+
+    ``costaudit`` (default: ``VESCALE_COSTAUDIT``, on) also activates the
+    plan-vs-reality cost auditor (costaudit.py): a prediction ledger every
+    priced plan records into, a per-step predicted-vs-measured join
+    publishing ``cost_model_*`` divergence gauges and the
+    ``cost-model-drift`` rule, and the online calibration harvest feeding
+    measured spans back into the active CalibrationTable."""
     global _STATE
     if _STATE is not None:
         shutdown()
@@ -140,6 +150,12 @@ def init(
             history=envreg.get_int("VESCALE_ALERTS_HISTORY"),
             min_eval_interval_s=envreg.get_float("VESCALE_ALERTS_EVAL_INTERVAL_S"),
         )
+    if costaudit is None:
+        costaudit = envreg.get_bool("VESCALE_COSTAUDIT")
+    if costaudit:
+        # after alerts: activation arms the cost-model-drift rule on the
+        # live engine when there is one
+        _STATE.costaudit = _costaudit.activate(_STATE.registry)
     return _STATE
 
 
@@ -149,6 +165,7 @@ def shutdown() -> None:
     global _STATE
     if _STATE is not None and _STATE.jsonl is not None:
         _STATE.jsonl.close()
+    _costaudit.deactivate()
     _memtrack.deactivate()
     _alerts.deactivate()
     _timeseries.deactivate()
@@ -212,10 +229,15 @@ def record_step(metrics: Dict[str, Any], kind: str = "train") -> None:
         # per-step memory sample: device gauges, tagged census, leak check
         # (None on census-interval skip steps — the jsonl line just omits it)
         mem = st.memtrack.on_step(st.step, reg)
-    # the step boundary IS the sampling/evaluation boundary: the history
-    # store keeps at most one sample per cadence and the engine rate-limits
-    # itself, so a kHz decode loop pays two no-op-ish calls per step
-    # (dormant runs pay the no-op hook references — the memtrack contract)
+    # the step boundary IS the sampling/evaluation boundary: the cost
+    # auditor joins predicted-vs-measured and publishes its divergence
+    # gauges FIRST so the history sample taken right after (and the
+    # cost-model-drift rule evaluating over it) sees this step's numbers;
+    # the store keeps at most one sample per cadence and the engine
+    # rate-limits itself, so a kHz decode loop pays three no-op-ish calls
+    # per step (dormant runs pay the no-op hook references — the memtrack
+    # contract)
+    audit = _costaudit.audit_step(kind)
     _timeseries.sample(kind)
     _alerts.evaluate()
     if st.jsonl is not None:
@@ -227,6 +249,8 @@ def record_step(metrics: Dict[str, Any], kind: str = "train") -> None:
         spans = _step_spans()
         if spans is not None:
             rec["spans"] = spans
+        if audit is not None:
+            rec["cost_audit"] = audit
         st.jsonl.emit(rec)
 
 
@@ -301,6 +325,14 @@ def write_step_report(
         st.registry.gauge(f"step_report_{name}_peak_bytes").set(report["peak_bytes"])
     drift = report.get("aot_drift")
     if drift is not None:
+        # the AOT memory budget is a priced plan too: ledger it so the
+        # train path always has joined predictions (instant join — both
+        # sides are known at compile time)
+        pid = _costaudit.record_prediction(
+            "aot_memory", predicted_bytes=drift["aot_bytes"], unit="bytes",
+            detail={"name": name, "source": drift["aot_source"]},
+        )
+        _costaudit.record_measurement(pid, measured_bytes=drift["measured_bytes"])
         st.registry.gauge(f"step_report_{name}_aot_drift_frac").set(drift["drift_frac"])
         if drift["exceeds_tolerance"]:
             # the AOT-drift watcher routes through the alert engine (ONE

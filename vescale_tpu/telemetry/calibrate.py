@@ -112,23 +112,35 @@ class CalibrationTable:
     _digest: Optional[str] = dataclasses.field(default=None, repr=False, compare=False)
 
     # ------------------------------------------------------------- build
-    def add_sample(self, op: str, axis_size: int, nbytes: int, seconds: float) -> None:
+    def add_sample(self, op: str, axis_size: int, nbytes: int, seconds: float,
+                   decay: Optional[float] = None) -> None:
+        """Fold one measurement into its bucket.  Default: the plain
+        running mean (every sample weighs ``1/n`` — the sweep's batch
+        semantics).  ``decay``: a fixed EWMA weight for the ONLINE harvest
+        (costaudit.py) — recent wall-clock outweighs history, so a table
+        skewed by stale measurements converges back to reality instead of
+        averaging it away."""
         key = (str(op), int(axis_size), _bucket(nbytes))
         cell = self.entries.get(key)
         us = float(seconds) * 1e6
         self._digest = None  # content changed: drop the memoized hash
         if cell is None:
             self.entries[key] = {"us": us, "samples": 1}
+        elif decay is not None:
+            a = min(1.0, max(0.0, float(decay)))
+            cell["us"] += a * (us - cell["us"])
+            cell["samples"] += 1
         else:
             n = cell["samples"] + 1
             cell["us"] += (us - cell["us"]) / n
             cell["samples"] = n
 
-    def ingest_spans(self, spans) -> int:
+    def ingest_spans(self, spans, decay: Optional[float] = None) -> int:
         """Harvest calibration samples from a span stream: any span whose
         tags carry ``collective_op``/``axis_size``/``bytes`` (the sweep's
         own spans, or runtime instrumentation honoring the contract).
-        Returns the number of samples absorbed."""
+        ``decay`` forwards to :meth:`add_sample` (the online harvest's
+        EWMA weight).  Returns the number of samples absorbed."""
         n = 0
         for s in spans:
             tags = getattr(s, "tags", None) or {}
@@ -137,12 +149,16 @@ class CalibrationTable:
             try:
                 self.add_sample(
                     tags["collective_op"], int(tags["axis_size"]),
-                    int(tags["bytes"]), float(s.duration),
+                    int(tags["bytes"]), float(s.duration), decay=decay,
                 )
                 n += 1
             except (TypeError, ValueError):
                 continue
         return n
+
+    # ``harvest`` is the contract name the audit layer and docs use for
+    # span-stream ingestion; same semantics as ingest_spans
+    harvest = ingest_spans
 
     # ------------------------------------------------------------ lookup
     def lookup_us(self, op: str, axis_size: int, nbytes: int) -> Optional[float]:
@@ -171,6 +187,19 @@ class CalibrationTable:
                 t = (math.log(n) - math.log(b0)) / (math.log(b1) - math.log(b0))
                 return math.exp(math.log(u0) * (1 - t) + math.log(u1) * t)
         return pts[-1][1]  # unreachable; defensive
+
+    def op_estimate_us(self, op: str) -> Optional[float]:
+        """Sample-weighted mean wall time over EVERY bucket of ``op`` —
+        the coarse single-number seed for consumers that know the op but
+        not the payload (the serve scheduler's audited ``retry_after_s``
+        seed reads ``serve_decode``).  None when the op was never
+        measured."""
+        total = weight = 0.0
+        for k, v in self.entries.items():
+            if k[0] == op:
+                total += v["us"] * v["samples"]
+                weight += v["samples"]
+        return total / weight if weight else None
 
     def matches_mesh(self, mesh) -> bool:
         """Staleness check: the table speaks for the mesh it measured.
@@ -226,13 +255,19 @@ class CalibrationTable:
         return self._digest
 
     def save(self, path: str) -> str:
+        """Atomic persist (tmp + rename): the online harvest rewrites the
+        table on a cadence while planners may re-read it mid-write via the
+        ``VESCALE_COST_CALIBRATION`` mtime reload — a torn read must be
+        impossible."""
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         data = self.to_json()
         data["digest"] = self.digest()
-        with open(path, "w") as f:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
         return path
 
     def launch_us(self) -> float:

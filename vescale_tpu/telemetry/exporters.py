@@ -176,6 +176,12 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     # live firing-count gauge and the per-rule 0/1/2 state gauges
     # (telemetry/alerts.py _emit feed)
     _al = ("alerts_",)
+    # cost-model audit block: prediction/match totals, harvested-span and
+    # digest-rotation counters, the divergence gauges the drift rule reads
+    # (telemetry/costaudit.py audit_step feed)
+    _cm = ("cost_model_",)
+    cm_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_cm)}
+    cm_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_cm)}
     al_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_al)}
     al_gauges = {n: v for n, v in snap["gauges"].items() if n.startswith(_al)}
     kn_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_kn)}
@@ -194,7 +200,7 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     other_gauges = {
         n: v
         for n, v in snap["gauges"].items()
-        if not n.startswith(("mem_",) + _res + _qc + _tr + _cp + _sv + _kn + _fl + _al)
+        if not n.startswith(("mem_",) + _res + _qc + _tr + _cp + _sv + _kn + _fl + _al + _cm)
     }
     res_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_res)}
     qc_counters = {n: v for n, v in snap["counters"].items() if n.startswith(_qc)}
@@ -204,7 +210,7 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
     other_counters = {
         n: v
         for n, v in snap["counters"].items()
-        if not n.startswith(_res + _qc + _tr + _cp + _sv + _kn + _fl + _al)
+        if not n.startswith(_res + _qc + _tr + _cp + _sv + _kn + _fl + _al + _cm)
     }
     if other_counters:
         lines.append("counters:")
@@ -272,6 +278,15 @@ def dashboard(registry: MetricsRegistry, title: str = "telemetry") -> str:
             lines.append(f"  {name:<48} {_fmt(ft_counters[name]):>12}")
         for name in sorted(ft_gauges):
             lines.append(f"  {name:<48} {ft_gauges[name]:>12.6g}")
+    if cm_counters or cm_gauges:
+        # cost-model audit block: is the price list honest — divergence
+        # ratio (max(m/p, p/m), 1.0 = perfect), match totals, and how much
+        # measured reality the online harvest has folded back
+        lines.append("cost-model:")
+        for name in sorted(cm_counters):
+            lines.append(f"  {name:<48} {_fmt(cm_counters[name]):>12}")
+        for name in sorted(cm_gauges):
+            lines.append(f"  {name:<48} {cm_gauges[name]:>12.6g}")
     if al_counters or al_gauges:
         # alert-engine block: the lifecycle totals + per-rule state gauges
         # (0=ok 1=pending 2=firing), then the live engine's firing/pending

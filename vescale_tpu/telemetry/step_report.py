@@ -115,6 +115,15 @@ def build_step_report(
     except Exception:
         text = lowered.as_text()
     report["collectives"] = count_collectives(text)
+    try:
+        from .costaudit import layer_attribution
+
+        # per-layer roofline attribution over the same optimized HLO the
+        # collective counter reads: FLOPs/bytes per op_name scope,
+        # compute- vs memory-bound against the device roofline
+        report["layer_attribution"] = layer_attribution(text)
+    except Exception as e:  # degrade, never fail a run for observability
+        report["layer_attribution"] = {"error": repr(e)}
     if aot_report is not None:
         from .memory_report import compare_with_aot
 
