@@ -1693,6 +1693,17 @@ def _dispatch():
         import fleet_smoke
 
         print(json.dumps(fleet_smoke.run_bench()))
+    elif which == "autoscale":
+        # fleet autoscaling rung (VESCALE_BENCH=autoscale): 5x-capacity
+        # spike on real children -> scale-up latency + p99 TTFT recovery
+        # (zero lost rids), plus the quiescent overhead lines — throttled
+        # autoscaler tick and per-tenant submit accounting, both amortized
+        # against a MEASURED decode step (<1% bar) —
+        # scripts/autoscale_smoke.py emits the line
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import autoscale_smoke
+
+        print(json.dumps(autoscale_smoke.run_bench()))
     elif which == "quantcomm":
         # quantized gradient collectives (VESCALE_BENCH=quantcomm): the
         # 2-proc gloo rig's grad-reduce bytes-on-the-wire + step time,

@@ -1,0 +1,614 @@
+"""Autoscaler + rolling-rollout smoke — the acceptance run of ISSUE 19.
+
+One closed-loop fleet leg on real replica children (tiny llama,
+seed-identical params — fleet_smoke's child, reused verbatim), walked
+through the whole "fleet that operates itself" story:
+
+  golden      one replica, the SAME load throttled to capacity (no
+              overload, no autoscaler, no rollout).  Every rid completes;
+              the per-rid token streams become the cross-leg truth.
+
+  autoscale   a 5x-capacity traffic spike lands open-loop on a 1-replica
+              fleet.  The queue-depth signal (sampled into the PR-16
+              time-series store off the router's own /fleet publishes)
+              crosses the up-threshold, holds, and the Autoscaler spawns
+              a clone via ``FleetSupervisor.spawn_like`` — fresh reserved
+              port, faultsim env dropped — and the router readmits it
+              through the existing half-open breaker probe (the clone's
+              cold jax import means its breaker OPENS first, then closes
+              on the probe: the readmission path is exercised by
+              construction).  p99 TTFT at spike vs after recovery is
+              recorded.  Shed rids are client-resubmitted until complete:
+              at the end the fleet ledger balances with ZERO lost / ZERO
+              duplicated rids and every token stream is BIT-IDENTICAL to
+              golden.
+
+  rollout     a rolling weight rollout of a checkpoint holding the SAME
+              params (the fixed-seed trick again), replica at a time:
+              drain -> baseline -> swap -> canary -> commit.  First
+              attempt: the template replica is env-armed with
+              ``canary_diverge:count=1`` — one logit sign flips during
+              the canary replay, the twin replays disagree, the replica
+              self-rolls-back and the controller auto-rolls-back the
+              whole fleet (nothing stays committed).  Second attempt
+              (the fault is consumed): clean sweep, both replicas
+              committed + finalized.  Post-rollout traffic is
+              BIT-IDENTICAL to golden — the swapped-in weights really
+              are the checkpoint's.
+
+  scale-down  the spike is over: the under-threshold signal holds and
+              the Autoscaler drains the clone (SIGTERM, non-blocking),
+              harvests its linger window, and removes it from the router
+              once the process is gone — sessions re-home to the
+              survivor via the affinity ring.
+
+The driver runs ndtimeline live: the run must leave ``fleet-scale``
+spans (directions up AND down) and ``fleet-rollout-stage`` spans on the
+router's ring — the stitched-timeline vocabulary of ISSUE 14.
+
+``run_bench()`` is the ``VESCALE_BENCH=autoscale`` rung: the spike ->
+scale-up -> recovery arc with p99-TTFT-at-spike vs recovered recorded,
+plus the QUIESCENT overhead lines — an idle autoscaler tick and the
+per-request tenant-accounting delta, both amortized over a measured
+decode step (acceptance < 1%).
+
+Exit 0 on success.  Wired into scripts/run_test.sh and tier-1 via
+tests/test_autoscale.py.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+SLOTS = 2
+MAX_QUEUE = 6
+CAPACITY = SLOTS + MAX_QUEUE        # one replica's admission bound
+SPIKE = 5 * CAPACITY                # the 5x overload (rids 0..39)
+WAVE2 = 6                           # rids 200..205, post-rollout traffic
+DIVERGE_SCHEDULE = "canary_diverge:call=0,count=1"
+CANARY_PROMPTS = [[1, 2, 3], [4, 5, 6, 7]]
+
+
+def _prompts(n, base_rid=0):
+    import numpy as np
+
+    rng = np.random.default_rng(31)
+    out = []
+    for i in range(n):
+        prompt = tuple(int(x) for x in rng.integers(1, 60, 3 + (i % 3)))
+        out.append((base_rid + i, prompt, 4 + (i % 3)))
+    return out
+
+
+def _specs(workdir, arm_template=False):
+    import fleet_smoke
+
+    from vescale_tpu.serve import ReplicaSpec
+    from vescale_tpu.testing import make_child_env, reserve_port
+
+    env = make_child_env(
+        0, 0, 1, device_count=1,
+        scrub=("VESCALE_FAULTSIM", "VESCALE_SERVE_OPS_PORT",
+               "VESCALE_SERVE_REPLICA_ID", "VESCALE_KERNELS"),
+        extra={"VESCALE_SERVE_MAX_QUEUE": MAX_QUEUE},
+    )
+    if arm_template:
+        env["VESCALE_FAULTSIM"] = DIVERGE_SCHEDULE
+    return [ReplicaSpec(
+        "r0",
+        [sys.executable, os.path.abspath(fleet_smoke.__file__),
+         "--child", "smoke"],
+        reserve_port(),
+        env=env,
+        log_path=os.path.join(workdir, "r0.log"),
+        # spawn_like drops this from the clone: the canary fault stays
+        # aimed at the template replica only
+        restart_env_drop=("VESCALE_FAULTSIM",),
+    )]
+
+
+def _router():
+    from vescale_tpu.serve import FleetRouter, HttpReplicaClient
+
+    return FleetRouter(
+        poll_interval_s=0.05, breaker_failures=2, breaker_cooldown_s=0.5,
+        dispatch_retries=4, backoff_s=0.05, backoff_max_s=0.5, hedge_s=0.0,
+    ), HttpReplicaClient
+
+
+def _wait_up(fr, sup, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sup.poll()
+        fr.poll(force=True)
+        if fr.replicas and all(
+            h.feed is not None and h.breaker.state == "closed"
+            for h in fr.replicas.values()
+        ):
+            return
+        time.sleep(0.2)
+    raise TimeoutError("fleet never came up")
+
+
+def _ttft_p99(fr):
+    vals = [h.feed["ttft_s"]["p99"] for h in fr.replicas.values()
+            if h.feed and h.feed["ttft_s"]["p99"] is not None]
+    return max(vals) if vals else None
+
+
+def _drain(fr, sup, autoscaler=None, timeout=240.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        sup.poll()
+        if autoscaler is not None:
+            autoscaler.tick()
+        if fr.pump() == 0:
+            return
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"drain stuck: pending={[r.req.rid for r in fr.ledger.pending()]}"
+            )
+        time.sleep(0.05)
+
+
+def _complete_all(fr, sup, waves, autoscaler=None, rounds=60):
+    """Drain, then client-resubmit any terminal-shed rid (the
+    retry_after_s contract) until EVERY rid completed — zero lost."""
+    from vescale_tpu.serve import Request
+
+    by_rid = {rid: (prompt, max_new) for rid, prompt, max_new in waves}
+    for _ in range(rounds):
+        _drain(fr, sup, autoscaler=autoscaler)
+        shed = [rid for rid in by_rid
+                if fr.ledger.records[rid].status != "completed"]
+        if not shed:
+            return
+        time.sleep(0.2)  # honor the backpressure hint before retrying
+        # resubmit at most two queue-fulls per round: hammering the full
+        # backlog back in just sheds it again
+        for rid in shed[:2 * MAX_QUEUE]:
+            prompt, max_new = by_rid[rid]
+            fr.submit(Request(rid=rid, prompt=prompt, max_new_tokens=max_new))
+    raise AssertionError(f"rids never completed after {rounds} rounds: {shed}")
+
+
+def _completed_tokens(fr, rids):
+    return {rid: fr.ledger.records[rid].outcome["tokens"] for rid in rids}
+
+
+# ------------------------------------------------------------------ golden
+def _golden_leg(workdir):
+    """One replica, throttled submission: the bit-identity reference."""
+    from vescale_tpu.serve import FleetSupervisor
+
+    specs = _specs(os.path.join(workdir, "golden"))
+    os.makedirs(os.path.join(workdir, "golden"), exist_ok=True)
+    fr, Client = _router()
+    sup = FleetSupervisor(specs, max_restarts=2, restart_backoff_s=0.3).start()
+    try:
+        fr.add_replica("r0", Client(specs[0].url))
+        _wait_up(fr, sup)
+        waves = _prompts(SPIKE) + _prompts(WAVE2, base_rid=200)
+        from vescale_tpu.serve import Request
+
+        for i in range(0, len(waves), MAX_QUEUE):
+            for rid, prompt, max_new in waves[i:i + MAX_QUEUE]:
+                fr.submit(Request(rid=rid, prompt=prompt,
+                                  max_new_tokens=max_new))
+            _drain(fr, sup)
+        # instantaneous-queue races can still shed a few: resubmit until
+        # every rid completed (the same client contract the spike leg uses)
+        _complete_all(fr, sup, waves)
+        fr.fleet_ledger_check()
+        return _completed_tokens(fr, [w[0] for w in waves])
+    finally:
+        sup.stop_all(grace_s=30.0)
+
+
+# -------------------------------------------------------------- closed loop
+def _save_rollout_checkpoint(workdir):
+    """The rollout target: a checkpoint of the SAME fixed-seed params the
+    children serve — post-rollout decode must stay bit-identical."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import vescale_tpu.checkpoint as ckpt
+    from vescale_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, dtype=jnp.float32,
+    )
+    params = Llama(cfg).init(jax.random.key(0),
+                             jnp.ones((1, 8), jnp.int32))["params"]
+    root = os.path.join(workdir, "rollout_ckpt")
+    ckpt.save(root, {"model": params})
+    return root
+
+
+def _autoscale_leg(workdir, golden_tokens):
+    import vescale_tpu.telemetry as telemetry
+    from vescale_tpu.ndtimeline import api as nd_api
+    from vescale_tpu.serve import (
+        Autoscaler,
+        FleetSupervisor,
+        Request,
+        RolloutController,
+    )
+    from vescale_tpu.telemetry import timeseries as _ts
+
+    telemetry.init(out_dir=None, memtrack=False, jsonl=False,
+                   timeseries=True, alerts=True, timeseries_cadence_s=0.0)
+    mgr = nd_api.init_ndtimers(rank=0)
+    legdir = os.path.join(workdir, "autoscale")
+    os.makedirs(legdir, exist_ok=True)
+    specs = _specs(legdir, arm_template=True)
+    fr, Client = _router()
+    sup = FleetSupervisor(specs, max_restarts=2, restart_backoff_s=0.3).start()
+    try:
+        fr.add_replica("r0", Client(specs[0].url))
+        _wait_up(fr, sup)
+        autoscaler = Autoscaler(
+            fr, sup, "r0",
+            client_factory=lambda spec: Client(spec.url),
+            min_replicas=1, max_replicas=2,
+            up_burn=1.0, down_burn=0.5, up_queue=4,
+            up_hold_s=0.3, down_hold_s=1.5, cooldown_s=2.0, window_s=3.0,
+        )
+
+        # ---- the 5x spike, open loop: queue depth blows past up_queue
+        spike = _prompts(SPIKE)
+        for rid, prompt, max_new in spike:
+            fr.submit(Request(rid=rid, prompt=prompt, max_new_tokens=max_new),
+                      session=f"sess{rid % 4}" if rid % 2 == 0 else None)
+        scale_at = ttft_spike = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            sup.poll()
+            fr.pump()
+            d = autoscaler.tick()
+            if d.startswith("scale_up"):
+                scale_at = time.monotonic()
+                ttft_spike = _ttft_p99(fr)
+                break
+            time.sleep(0.05)
+        assert scale_at is not None, (
+            f"spike never tripped scale-up: {autoscaler.last_signals}"
+        )
+        sig = autoscaler.last_signals
+        assert sig["queue_depth"] is not None and sig["queue_depth"] >= 4, sig
+        # the signal came through the PR-16 store, sampled off the
+        # router's own /fleet publishes
+        store = _ts.get_store()
+        assert store is not None
+        assert store.reduce("fleet_timeline_queue_depth", 60.0, "last") is not None
+        assert len(fr.replicas) == 2 and autoscaler.scale_ups == 1
+        clone = next(rid for rid in fr.replicas if rid != "r0")
+        assert clone in sup.managed and sup.alive(clone)
+
+        # ---- readmission: the clone's breaker opens during its cold
+        # import, then the half-open probe lets it back in
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            sup.poll()
+            fr.pump()
+            autoscaler.tick()
+            if fr.replicas[clone].breaker.state == "closed":
+                break
+            time.sleep(0.1)
+        assert fr.replicas[clone].breaker.state == "closed", "clone never readmitted"
+
+        # ---- everything completes bit-identically (sheds resubmitted)
+        _complete_all(fr, sup, spike, autoscaler=autoscaler)
+        fr.fleet_ledger_check()
+        spike_tokens = _completed_tokens(fr, [w[0] for w in spike])
+        for rid, toks in spike_tokens.items():
+            assert toks == golden_tokens[rid], (rid, toks, golden_tokens[rid])
+        # TTFT recovery, attributed by construction: r0's histogram holds
+        # the overloaded spike tail (it served alone pre-scale-up), the
+        # clone's holds only post-scale-up service
+        fr.poll(force=True)
+        ttft_spike = ttft_spike or (
+            (fr.replicas["r0"].feed or {}).get("ttft_s", {}).get("p99"))
+        ttft_rec = (fr.replicas[clone].feed or {}).get("ttft_s", {}).get("p99")
+        clone_stats = fr.summary()["replicas"][clone]
+        assert clone_stats["closes"] >= 1, (
+            "clone joined without a half-open readmission"
+        )
+        print(f"autoscale: scale-up fired (signals={sig}), clone {clone} "
+              f"readmitted, {SPIKE} rids bit-identical; "
+              f"ttft_p99 spike={ttft_spike} recovered={ttft_rec}")
+
+        # ---- rolling rollout #1: canary_diverge armed on r0 -> fleet
+        # auto-rollback (nothing stays committed)
+        ckpt_root = _save_rollout_checkpoint(workdir)
+        diverge = RolloutController(
+            fr, ckpt_root, CANARY_PROMPTS, max_new_tokens=4,
+            canary=True, baseline=True, stage_timeout_s=180.0,
+        ).run()
+        assert diverge["ok"] is False, diverge
+        assert diverge["diverged"] == "r0", diverge
+        assert diverge["committed"] == [], diverge
+        assert "deterministic" in (diverge["reason"] or ""), diverge
+
+        # ---- rolling rollout #2: the fault is consumed -> clean sweep
+        clean = RolloutController(
+            fr, ckpt_root, CANARY_PROMPTS, max_new_tokens=4,
+            canary=True, baseline=True, stage_timeout_s=180.0,
+        ).run()
+        assert clean["ok"] is True, clean
+        assert sorted(clean["committed"]) == sorted(fr.replicas), clean
+        print(f"rollout: diverge auto-rolled-back {diverge['rolled_back']}, "
+              f"clean sweep committed {clean['committed']}")
+
+        # ---- post-rollout traffic: the swapped weights ARE the ckpt's
+        wave2 = _prompts(WAVE2, base_rid=200)
+        for rid, prompt, max_new in wave2:
+            fr.submit(Request(rid=rid, prompt=prompt, max_new_tokens=max_new))
+        _complete_all(fr, sup, wave2, autoscaler=None)
+        for rid, toks in _completed_tokens(fr, [w[0] for w in wave2]).items():
+            assert toks == golden_tokens[rid], (rid, toks)
+
+        # ---- quiet fleet: the under-threshold hold drains the clone
+        deadline = time.monotonic() + 120.0
+        scale_down_seen = False
+        while time.monotonic() < deadline:
+            sup.poll()
+            fr.pump()
+            d = autoscaler.tick()
+            scale_down_seen = scale_down_seen or d.startswith("scale_down")
+            if scale_down_seen and len(fr.replicas) == 1:
+                break
+            time.sleep(0.1)
+        assert scale_down_seen and len(fr.replicas) == 1, (
+            f"clone never drained: {autoscaler.last_decision}"
+        )
+        assert autoscaler.scale_downs == 1
+        assert not sup.alive(clone)
+        assert fr.pick(session="sess0").id == "r0"  # ring re-homed
+        fr.fleet_ledger_check()
+
+        # ---- the run left its span vocabulary on the router's ring
+        spans = mgr.flush()
+        scale_dirs = {s.tags.get("direction") for s in spans
+                      if s.metric == "fleet-scale"}
+        assert scale_dirs == {"up", "down"}, scale_dirs
+        stages = {s.tags.get("stage") for s in spans
+                  if s.metric == "fleet-rollout-stage"}
+        assert "fleet-leg" in stages, stages
+        counts = fr.summary()["counts"]
+        return {"ttft_spike": ttft_spike, "ttft_recovered": ttft_rec,
+                "counts": counts}
+    finally:
+        sup.stop_all(grace_s=30.0)
+        nd_api.deinit_ndtimers()
+        telemetry.shutdown()
+
+
+def main() -> None:
+    import shutil
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="autoscale_smoke_")
+    t0 = time.monotonic()
+    try:
+        golden = _golden_leg(work)
+        res = _autoscale_leg(work, golden)
+        print(
+            "AUTOSCALE SMOKE OK: 5x spike -> scale-up -> half-open readmit "
+            "-> bit-identical completion (zero lost/dup rids); rolling "
+            "rollout auto-rolled-back on canary_diverge then committed "
+            "clean; quiet fleet scaled back down "
+            f"(counts={json.dumps(res['counts'], sort_keys=True)}, "
+            f"{time.monotonic() - t0:.1f}s)"
+        )
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+# ------------------------------------------------------------------- bench
+def run_bench() -> dict:
+    """The ``VESCALE_BENCH=autoscale`` rung: the spike -> scale-up ->
+    recovery arc (p99 TTFT at spike vs recovered, rids lost = 0) plus the
+    QUIESCENT overhead lines — what an idle autoscaler tick and the
+    per-request tenant accounting add to a measured decode step."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from vescale_tpu.mesh import DeviceMesh
+    from vescale_tpu.models.llama import Llama, LlamaConfig
+    from vescale_tpu.serve import (
+        Autoscaler,
+        ContinuousBatchingScheduler,
+        FleetSupervisor,
+        KVCacheConfig,
+        PagedKVCache,
+        Request,
+        ServeEngine,
+    )
+
+    # ---- spike -> scale-up -> recovery on real children
+    work = tempfile.mkdtemp(prefix="autoscale_bench_")
+    try:
+        specs = _specs(work)
+        fr, Client = _router()
+        sup = FleetSupervisor(specs, max_restarts=2, restart_backoff_s=0.3)
+        sup.start()
+        try:
+            fr.add_replica("r0", Client(specs[0].url))
+            _wait_up(fr, sup)
+            autoscaler = Autoscaler(
+                fr, sup, "r0", client_factory=lambda s: Client(s.url),
+                min_replicas=1, max_replicas=2, up_queue=4, up_hold_s=0.2,
+                down_hold_s=3600.0, cooldown_s=1.0, window_s=3.0,
+            )
+            spike = _prompts(SPIKE)
+            t0 = time.monotonic()
+            for rid, prompt, max_new in spike:
+                fr.submit(Request(rid=rid, prompt=prompt,
+                                  max_new_tokens=max_new))
+            ttft_spike = scale_up_s = None
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                sup.poll()
+                fr.pump()
+                if autoscaler.tick().startswith("scale_up"):
+                    scale_up_s = time.monotonic() - t0
+                    ttft_spike = _ttft_p99(fr)
+                    break
+                time.sleep(0.05)
+            _complete_all(fr, sup, spike, autoscaler=autoscaler)
+            wall = time.monotonic() - t0
+            fr.fleet_ledger_check()
+            # same attribution as the smoke: r0 served the pre-scale-up
+            # overload alone, the clone only post-scale-up traffic
+            fr.poll(force=True)
+            ttft_spike = ttft_spike or (
+                (fr.replicas["r0"].feed or {}).get("ttft_s", {}).get("p99"))
+            clone = next((rid for rid in fr.replicas if rid != "r0"), None)
+            ttft_rec = (
+                (fr.replicas[clone].feed or {}).get("ttft_s", {}).get("p99")
+                if clone else _ttft_p99(fr))
+            counts = fr.summary()["counts"]
+            completed_tokens = sum(
+                len(rec.outcome["tokens"])
+                for rec in fr.ledger.records.values()
+                if rec.status == "completed"
+            )
+        finally:
+            sup.stop_all(grace_s=30.0)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    # ---- quiescent overhead, amortized over a MEASURED decode step
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=8,
+        max_position_embeddings=64, dtype=jnp.float32,
+    )
+    mesh = DeviceMesh(("tp",), (1,), devices=jax.devices()[:1])
+    params = Llama(cfg).init(jax.random.key(0),
+                             jnp.ones((1, 8), jnp.int32))["params"]
+    kc = KVCacheConfig(
+        layers=cfg.num_hidden_layers, kv_heads=cfg.num_key_value_heads,
+        head_dim=cfg.head_dim, num_slots=SLOTS, page_size=4, pages_per_slot=8,
+    )
+    import numpy as np
+
+    cache = PagedKVCache(kc, mesh)
+    engine = ServeEngine(cfg, mesh, params, cache)
+    # one loaded decode step, min over reps (the serve rung's estimator)
+    slot = cache.alloc(3, 24)
+    row = engine.prefill([1, 2, 3], slot)
+    cache.commit_prefill(slot, 3)
+    tok = engine.greedy(row)
+    step_s = float("inf")
+    for _ in range(20):
+        toks = np.zeros((cache.num_slots,), np.int32)
+        toks[slot] = tok
+        t0 = time.perf_counter()
+        logits = engine.decode(toks)
+        step_s = min(step_s, time.perf_counter() - t0)
+        cache.advance(slot)
+        tok = engine.greedy(logits[slot])
+    cache.free(slot)
+
+    # idle autoscaler tick: a live router object, quiet signals — the
+    # per-step cost when nothing is happening (the common case)
+    from vescale_tpu.serve import FleetRouter
+
+    class _Idle:
+        def poll_router(self):
+            return {"schema_version": 2, "replica_id": "L", "accepting": True,
+                    "draining": False, "queue_depth": 0, "inflight": 0,
+                    "slots": 4, "free_slots": 4, "pages": 16, "free_pages": 16,
+                    "ttft_s": {"p50": None, "p95": None, "p99": None},
+                    "itl_s": {"p50": None, "p95": None, "p99": None},
+                    "shed_rate": 0.0, "retry_after_s": 0.01,
+                    "goodput_tokens_per_s": 0.0,
+                    "throughput_tokens_per_s": 0.0, "mfu": None,
+                    "decode_steps": 1, "serve_step": 1, "uptime_s": 1.0,
+                    "rank": 0}
+
+    class _IdleSup:
+        managed = {}
+
+        def spawn_like(self, t):
+            raise AssertionError("idle bench must not scale")
+
+        def drain(self, r):
+            raise AssertionError("idle bench must not scale")
+
+        def alive(self, r):
+            return True
+
+    r = FleetRouter(poll_interval_s=3600.0, breaker_failures=3,
+                    breaker_cooldown_s=1.0, dispatch_retries=1,
+                    backoff_s=0.0, backoff_max_s=0.0, hedge_s=0.0)
+    r.add_replica("L", _Idle())
+    r.poll(force=True)
+    idle = Autoscaler(r, _IdleSup(), "L", min_replicas=1, max_replicas=2)
+    iters, reps = 2000, 5
+    tick_s = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            idle.tick()
+        tick_s = min(tick_s, (time.perf_counter() - t0) / iters)
+
+    # tenant accounting: submit+shed-check cost with weights vs without
+    def _submit_min(**kw):
+        best = float("inf")
+        for _ in range(reps):
+            cache.reset()
+            s = ContinuousBatchingScheduler(cache, max_queue=iters + 8, **kw)
+            t0 = time.perf_counter()
+            for i in range(iters):
+                s.submit(Request(rid=i, prompt=(1, 2), max_new_tokens=1,
+                                 tenant="gold"), step=0)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best
+
+    plain_s = _submit_min()
+    tenant_s = _submit_min(tenant_weights={"gold": 3.0, "free": 1.0})
+    tenant_added = max(0.0, tenant_s - plain_s)
+
+    return {
+        "metric": "autoscale_recovery_cpu",
+        "value": round((ttft_rec or 0.0) * 1e3, 3),
+        "unit": "ms",
+        "overload_factor": 5,
+        "requests": SPIKE,
+        "completed": counts["completed"],
+        "lost": SPIKE - counts["completed"],
+        "scale_up_after_s": round(scale_up_s, 2) if scale_up_s else None,
+        "ttft_p99_spike_ms": round((ttft_spike or 0.0) * 1e3, 3),
+        "ttft_p99_recovered_ms": round((ttft_rec or 0.0) * 1e3, 3),
+        "tokens_per_s": round(completed_tokens / wall, 2),
+        "wall_s": round(wall, 2),
+        "decode_step_ms": round(step_s * 1e3, 3),
+        "autoscaler_tick_us": round(tick_s * 1e6, 2),
+        "tenant_submit_added_us": round(tenant_added * 1e6, 2),
+        # one idle tick per decode step / one tenant-accounted submit per
+        # request-sized decode — both as fractions of the measured step
+        "autoscaler_overhead_frac": round(tick_s / step_s, 5),
+        "tenant_overhead_frac": round(tenant_added / step_s, 5),
+        "acceptance_lt": 0.01,
+    }
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,8 @@ echo "=== alert smoke (slow_decode fault -> burn-rate rule pending->firing->reso
 python scripts/alert_smoke.py || failed=1
 echo "=== cost-audit smoke (skewed table -> drift fires -> recalibration self-heals the plan; serve joins; dormant bit-identical)"
 python scripts/costaudit_smoke.py || failed=1
+echo "=== autoscale smoke (5x spike -> scale-up -> readmit; rolling rollout canary auto-rollback then clean commit; quiet scale-down)"
+python scripts/autoscale_smoke.py || failed=1
 echo "=== what-if CLI smoke (audited (dp,tp,pp) re-scoring)"
 python -m vescale_tpu.analysis whatif --devices 8 --top 3 || failed=1
 for f in tests/test_*.py; do

@@ -352,6 +352,64 @@ def test_zero_for_s_fires_immediately():
     assert (tr["from"], tr["to"]) == ("ok", "firing")
 
 
+def test_resolve_for_s_holds_firing_through_quiet_blips():
+    """ISSUE 19 satellite: the symmetric hysteresis on the way DOWN.  One
+    quiet sample must not un-page; the rule has to stay below threshold
+    for resolve_for_s before the firing -> ok edge."""
+    store = _store()
+    eng = AlertEngine(store=store)
+    eng.add_rule(ThresholdRule(
+        "hot", "temp", ">", 100.0, window_s=30.0, reducer="last",
+        resolve_for_s=10.0,
+    ))
+    g = store.registry.gauge("temp")
+    g.set(150.0)
+    store.sample(now=T0, force=True)
+    (tr,) = eng.evaluate(now=T0)
+    assert (tr["from"], tr["to"]) == ("ok", "firing")
+
+    # first quiet sample: below threshold, but inside the hold -> STILL
+    # firing (value refreshes so the feed shows the current reading)
+    g.set(50.0)
+    store.sample(now=T0 + 5, force=True)
+    assert eng.evaluate(now=T0 + 5) == []
+    assert eng.firing() == ["hot"]
+    assert eng.state_of("hot")["value"] == 50.0
+
+    # flapping back above threshold RESETS the resolve clock
+    g.set(150.0)
+    store.sample(now=T0 + 8, force=True)
+    assert eng.evaluate(now=T0 + 8) == []  # dedup: still firing
+    g.set(50.0)
+    store.sample(now=T0 + 12, force=True)
+    assert eng.evaluate(now=T0 + 12) == []  # only 4s below since the flap
+
+    # quiet long enough (12 -> 23 is > 10s below) -> resolve edge
+    store.sample(now=T0 + 17, force=True)
+    assert eng.evaluate(now=T0 + 17) == []
+    store.sample(now=T0 + 23, force=True)
+    (tr,) = eng.evaluate(now=T0 + 23)
+    assert (tr["from"], tr["to"]) == ("firing", "ok")
+    assert eng.counts["fired"] == 1 and eng.counts["resolved"] == 1
+
+
+def test_resolve_for_s_zero_resolves_immediately_and_validates():
+    store = _store()
+    eng = AlertEngine(store=store)
+    eng.add_rule(ThresholdRule("hot", "temp", ">", 100.0, window_s=30.0))
+    g = store.registry.gauge("temp")
+    g.set(150.0)
+    store.sample(now=T0, force=True)
+    eng.evaluate(now=T0)
+    g.set(50.0)
+    store.sample(now=T0 + 1, force=True)
+    (tr,) = eng.evaluate(now=T0 + 1)  # default 0.0: old single-sample edge
+    assert (tr["from"], tr["to"]) == ("firing", "ok")
+    with pytest.raises(ValueError):
+        ThresholdRule("bad", "temp", ">", 1.0, window_s=30.0,
+                      resolve_for_s=-1.0)
+
+
 def test_trend_rule_directions():
     store = _store()
     up = TrendRule("up", "q", slope_per_s=0.5, window_s=60.0, direction="up")

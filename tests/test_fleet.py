@@ -780,6 +780,117 @@ def test_supervisor_stop_cancels_scheduled_restart(tmp_path):
         sup.stop_all(grace_s=5.0)
 
 
+def test_spawn_like_clones_spec_with_fresh_port_and_env_drop(tmp_path):
+    """ISSUE 19 satellite: scale-up clones the template spec onto a fresh
+    reserved port + unique auto id, drops restart_env_drop vars (a fault
+    schedule aimed at the original fleet must not arm in the clone), and
+    suffixes the log path."""
+    from vescale_tpu.serve import FleetSupervisor, ReplicaSpec
+
+    spec = ReplicaSpec(
+        "s0", [sys.executable, "-c", "import time; time.sleep(120)"],
+        reserve_port(), env={"VESCALE_FAULTSIM": "die:count=1", "KEEP": "1"},
+        log_path=str(tmp_path / "s0.log"),
+        restart_env_drop=("VESCALE_FAULTSIM",),
+    )
+    sup = FleetSupervisor([spec], max_restarts=2, restart_backoff_s=0.05).start()
+    try:
+        c0 = sup.spawn_like("s0")
+        c1 = sup.spawn_like("s0")
+        assert (c0.replica_id, c1.replica_id) == ("s0-s0", "s0-s1")
+        ports = {spec.port, c0.port, c1.port}
+        assert len(ports) == 3  # reserve_port never reuses in-process
+        assert "VESCALE_FAULTSIM" not in c0.env and c0.env["KEEP"] == "1"
+        assert c0.log_path == str(tmp_path / "s0.log") + ".s0-s0"
+        assert sup.alive("s0-s0") and sup.alive("s0-s1")
+        assert c0.url.endswith(f":{c0.port}")
+        with pytest.raises(ValueError):
+            sup.spawn_like("s0", replica_id="s0-s1")  # already managed
+    finally:
+        sup.stop_all(grace_s=5.0)
+
+
+def test_supervisor_drain_is_nonblocking_and_never_respawns(tmp_path):
+    """ISSUE 19 satellite: drain() sends SIGTERM and returns immediately
+    (the autoscaler keeps pumping the router through the linger window);
+    a later poll() reaps the exit WITHOUT scheduling a respawn."""
+    from vescale_tpu.serve import FleetSupervisor, ReplicaSpec
+
+    spec = ReplicaSpec(
+        "s0", [sys.executable, "-c", "import time; time.sleep(120)"],
+        reserve_port(), log_path=str(tmp_path / "s0.log"),
+    )
+    sup = FleetSupervisor([spec], max_restarts=2, restart_backoff_s=0.01).start()
+    try:
+        t0 = time.monotonic()
+        sup.drain("s0")
+        assert time.monotonic() - t0 < 1.0  # never waits for the exit
+        deadline = time.monotonic() + 10
+        while sup.managed["s0"].proc is not None and time.monotonic() < deadline:
+            sup.poll()
+            time.sleep(0.01)
+        assert sup.managed["s0"].proc is None and not sup.alive("s0")
+        assert not sup._restart_at  # stopped-on-purpose: no resurrection
+        time.sleep(0.05)
+        sup.poll()
+        assert sup.managed["s0"].proc is None
+        assert sup.managed["s0"].restarts == 0
+    finally:
+        sup.stop_all(grace_s=5.0)
+
+
+def test_scale_down_drain_rehomes_sessions_with_zero_lost_rids():
+    """ISSUE 19 satellite: the scale-down choreography at router level.
+    While the victim drains (accepting=False) the router still HARVESTS
+    its in-flight outcomes through the linger window; new traffic for its
+    sessions spills to survivors; after removal the affinity ring
+    re-homes deterministically.  Net: zero lost, zero duplicated rids."""
+    a, b, c = FakeReplica("a"), FakeReplica("b"), FakeReplica("c")
+    fr, t = make_router([a, b, c])
+    fr.poll(force=True)
+    # find a session homed on each replica
+    home_to_session = {}
+    i = 0
+    while len(home_to_session) < 3 and i < 64:
+        sid = f"user-{i}"
+        home_to_session.setdefault(fr.pick(session=sid).id, sid)
+        i += 1
+    assert set(home_to_session) == {"a", "b", "c"}
+    sid_a = home_to_session["a"]
+    recs = [fr.submit(_req(i), session=sid_a) for i in range(3)]
+    assert all(r.live_on == ["a"] for r in recs)
+
+    # drain begins: the victim stops accepting but keeps its in-flight
+    a.feed_kw.update(draining=True, accepting=False)
+    fr.poll(force=True)
+    # new work for the SAME session spills to a survivor immediately
+    spill = fr.submit(_req(100), session=sid_a)
+    assert spill.live_on and spill.live_on[0] in ("b", "c")
+
+    # linger harvest: the draining replica finishes; the router, still
+    # polling it, collects the outcomes BEFORE the replica is removed
+    a.finish_all()
+    fr.pump()
+    assert all(not r.pending and r.status == "completed" for r in recs)
+    assert all(r.replica == "a" for r in recs)
+
+    # process exits -> autoscaler removes it; ring re-homes the session
+    fr.remove_replica("a")
+    assert "a" not in fr.replicas
+    new_home = fr.pick(session=sid_a).id
+    assert new_home in ("b", "c")
+    for _ in range(5):
+        assert fr.pick(session=sid_a).id == new_home  # stable re-home
+
+    (b if spill.live_on[0] == "b" else c).finish_all()
+    assert fr.pump() == 0
+    fr.fleet_ledger_check()  # EXACTLY one terminal outcome per rid
+    counts = fr.ledger.counts
+    assert counts["completed"] == 4
+    assert counts["submitted"] == 4 and counts["resubmitted"] == 0
+    assert fr.ledger.pending_count() == 0
+
+
 # ============================================== live replica end-to-end
 CFG = LlamaConfig(
     vocab_size=64, hidden_size=16, intermediate_size=32,

@@ -195,22 +195,30 @@ def test_router_schema_frozen_and_json_roundtrip(serve_rig):
     cache.reset()
     sched = ContinuousBatchingScheduler(cache, max_queue=8)
     obs = ServeObservability(sched, engine=eng, rank=0, replica_id="robs")
-    from vescale_tpu.serve.obs import ROUTER_FIELDS_V3
+    from vescale_tpu.serve.obs import ROUTER_FIELDS_V3, ROUTER_FIELDS_V4
 
     feed = json.loads(json.dumps(obs.router()))
     assert set(feed) == set(ROUTER_FIELDS)
     # the freeze contract across versions: fields are only ever ADDED —
     # every prior version stays a strict subset, so a router written
-    # against v1, v2 or v3 still runs against a v4 feed
-    assert ROUTER_FIELDS_V1 < ROUTER_FIELDS_V2 < ROUTER_FIELDS_V3 < ROUTER_FIELDS
+    # against v1..v4 still runs against a v5 feed
+    assert (
+        ROUTER_FIELDS_V1 < ROUTER_FIELDS_V2 < ROUTER_FIELDS_V3
+        < ROUTER_FIELDS_V4 < ROUTER_FIELDS
+    )
     assert set(ROUTER_FIELDS_V2) - set(ROUTER_FIELDS_V1) == {"replica_id", "accepting"}
     assert set(ROUTER_FIELDS_V3) - set(ROUTER_FIELDS_V2) == {
         "prefix_hit_rate", "spec_accept_rate",
     }
-    assert set(ROUTER_FIELDS) - set(ROUTER_FIELDS_V3) == {"alerts"}
-    assert feed["schema_version"] == ROUTER_SCHEMA_VERSION == 4
+    assert set(ROUTER_FIELDS_V4) - set(ROUTER_FIELDS_V3) == {"alerts"}
+    assert set(ROUTER_FIELDS) - set(ROUTER_FIELDS_V4) == {"tenants", "rollout"}
+    assert feed["schema_version"] == ROUTER_SCHEMA_VERSION == 5
     # v4 addition: the alert digest, dormant-safe shape
     assert set(feed["alerts"]) == {"active", "firing", "pending"}
+    # v5 additions: tenant stats empty until a non-default tenant
+    # submits; rollout null outside a weight rollout
+    assert feed["tenants"] == {}
+    assert feed["rollout"] is None
     assert feed["slots"] == 2 and feed["free_slots"] == 2
     assert set(feed["ttft_s"]) == {"p50", "p95", "p99"}
     assert set(feed["itl_s"]) == {"p50", "p95", "p99"}

@@ -649,10 +649,14 @@ def test_router_v3_rates_live(model_and_params, tp2_mesh):
 
 
 def test_fleet_replica_row_carries_warmth_fields():
-    from vescale_tpu.serve.obs import FLEET_REPLICA_FIELDS, FLEET_REPLICA_FIELDS_V1
+    from vescale_tpu.serve.obs import (
+        FLEET_REPLICA_FIELDS,
+        FLEET_REPLICA_FIELDS_V1,
+        FLEET_REPLICA_FIELDS_V2,
+    )
 
-    assert FLEET_REPLICA_FIELDS_V1 < FLEET_REPLICA_FIELDS
-    assert set(FLEET_REPLICA_FIELDS) - set(FLEET_REPLICA_FIELDS_V1) == {
+    assert FLEET_REPLICA_FIELDS_V1 < FLEET_REPLICA_FIELDS_V2 < FLEET_REPLICA_FIELDS
+    assert set(FLEET_REPLICA_FIELDS_V2) - set(FLEET_REPLICA_FIELDS_V1) == {
         "prefix_hit_rate", "spec_accept_rate",
     }
 

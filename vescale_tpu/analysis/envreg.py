@@ -344,6 +344,30 @@ register("VESCALE_FLEET_TRACE_FLUSH_EVERY", "int", 1,
          "Boundary cadence at which a fleet-traced replica flushes its span ring to the trace stream (1 = every boundary; higher trades crash-durability of the newest spans for fewer writes).")
 register("VESCALE_FLEET_OPS_PORT", "int", None,
          "Localhost port for the fleet ROUTER's own ops endpoints (`/fleet` aggregate rollup, `/healthz`, router-process `/metrics`): unset = off (no socket, no thread), 0 = auto-assign (docs/serving.md).")
+register("VESCALE_SERVE_TENANT_WEIGHTS", "str", None,
+         "Per-tenant SLO-class weights as `tenant:weight[,tenant:weight...]` (e.g. `gold:3,free:1`): each tenant's share of the admission queue is capped at max_queue x weight/total (unlisted tenants weigh 1.0), so an overloaded tenant sheds before it can starve the others; unset disables tenant-weighted shedding entirely (docs/serving.md).")
+
+# --- autoscaler (serve/autoscale.py) ---------------------------------
+register("VESCALE_AUTOSCALE_MIN", "int", 1,
+         "Lower replica-count bound of the fleet autoscaler: scale-down never drains below this many live replicas.")
+register("VESCALE_AUTOSCALE_MAX", "int", 4,
+         "Upper replica-count bound of the fleet autoscaler: scale-up never spawns past this many live replicas.")
+register("VESCALE_AUTOSCALE_UP_BURN", "float", 1.0,
+         "Scale-up threshold on the windowed `fleet_timeline_slo_burn_rate` average (>= 1 means the fleet is burning p99-TTFT error budget).")
+register("VESCALE_AUTOSCALE_DOWN_BURN", "float", 0.5,
+         "Scale-down threshold on the windowed burn-rate average; the gap up to VESCALE_AUTOSCALE_UP_BURN is the hysteresis dead zone where the fleet stays put.")
+register("VESCALE_AUTOSCALE_UP_QUEUE", "int", 4,
+         "Aggregate fleet queue depth (router-pending + replica queues) at or above which a rising queue trend also triggers scale-up, independent of the SLO burn signal.")
+register("VESCALE_AUTOSCALE_UP_HOLD_S", "float", 1.0,
+         "Seconds the scale-up condition must hold continuously before a replica is spawned (transient spikes don't scale).")
+register("VESCALE_AUTOSCALE_DOWN_HOLD_S", "float", 5.0,
+         "Seconds the scale-down condition must hold continuously before a replica is drained (asymmetric with up-hold: scaling down is the cautious direction).")
+register("VESCALE_AUTOSCALE_COOLDOWN_S", "float", 5.0,
+         "Seconds after ANY scale action during which the autoscaler makes no further decisions — the just-changed fleet must re-converge before its signals mean anything.")
+register("VESCALE_AUTOSCALE_WINDOW_S", "float", 10.0,
+         "Time-series window in seconds over which the autoscaler's burn-rate average and queue-depth slope are reduced.")
+register("VESCALE_AUTOSCALE_TICK_S", "float", 0.25,
+         "Autoscaler control-loop cadence in seconds: tick() calls arriving inside this interval return the cached last decision without recomputing signals, bounding autoscaler overhead in tight serve loops.")
 
 # --- trace timeline / cost calibration -------------------------------
 register("VESCALE_COST_CALIBRATION", "str", None,

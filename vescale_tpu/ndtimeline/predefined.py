@@ -56,6 +56,15 @@ FLEET_DISPATCH = "fleet-dispatch-attempt"
 FLEET_BACKOFF = "fleet-backoff"
 FLEET_BREAKER = "fleet-breaker"
 FLEET_TERMINAL = "fleet-terminal"
+# fleet self-operation (serve/autoscale.py + the serve loop's reload
+# machine; fleettrace.scale_event / rollout_stage emit).  Every
+# autoscaler decision (scale-up spawn, scale-down drain) and every
+# rolling-rollout stage (drain / baseline / swap / canary / commit /
+# rollback) lands as a span in the same streams the journeys live in, so
+# the merged fleet timeline shows the fleet operating itself inline with
+# the requests it affected.
+FLEET_SCALE = "fleet-scale"
+FLEET_ROLLOUT = "fleet-rollout-stage"
 # alert-engine lifecycle (telemetry/alerts.py emits): a point span per
 # transition plus, on resolve, one span covering the whole firing episode
 # — so a Perfetto timeline shows the alert as a bar spanning exactly the

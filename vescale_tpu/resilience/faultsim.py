@@ -69,6 +69,12 @@ Fault kinds and their hook sites:
                     network partition between a healthy replica and the
                     fleet router's poller: the breaker opens without the
                     replica dying, and readmission is probe-driven
+  canary_diverge    observed by the rollout canary replay
+                    (serve/autoscale.py) — ONE logit's sign is flipped
+                    while the pinned golden prompts replay through
+                    freshly swapped weights, so the canary's token-stream
+                    comparison diverges and the auto-rollback path is
+                    provable without a genuinely bad checkpoint
   ================  ====================================================
 
 Gating contract (the ``telemetry.init()`` pattern): while disarmed the
@@ -114,6 +120,7 @@ KINDS = (
     "slow_decode",
     "replica_kill",
     "poll_blackhole",
+    "canary_diverge",
 )
 
 # errors raised by `check` per kind; observation-level kinds (nonfinite_loss,

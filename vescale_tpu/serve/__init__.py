@@ -19,7 +19,8 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from . import fleet, fleettrace, obs, prefix_cache, reqtrace, router, speculative
+from . import autoscale, fleet, fleettrace, obs, prefix_cache, reqtrace, router, speculative
+from .autoscale import Autoscaler, RolloutController
 from .engine import ServeEngine
 from .fleet import FleetSupervisor, ReplicaSpec, RequestInbox, serve_replica
 from .fleettrace import (
@@ -30,7 +31,7 @@ from .fleettrace import (
     verify_fleet_journeys,
 )
 from .kv_cache import KVCacheConfig, KVCacheOutOfPages, PagedKVCache
-from .loop import ServeResult, run_serve_resilient
+from .loop import ControlChannel, ServeResult, run_serve_resilient
 from .obs import FleetObservability, ServeObservability
 from .prefix_cache import PrefixCache
 from .speculative import SpeculativeDecoder, load_drafter_params, slice_drafter_params
@@ -74,6 +75,10 @@ __all__ = [
     "ReplicaSpec",
     "FleetSupervisor",
     "serve_replica",
+    "Autoscaler",
+    "RolloutController",
+    "ControlChannel",
+    "autoscale",
     "obs",
     "prefix_cache",
     "reqtrace",

@@ -144,6 +144,36 @@ class ServeEngine:
         sharding = NamedSharding(self.mesh.jax_mesh, P())
         return jax.make_array_from_callback(host.shape, sharding, lambda idx: host[idx])
 
+    def swap_params(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Hot-swap the weight tree WITHOUT rebuilding: every compiled
+        program takes ``params`` as an argument, so a tree with identical
+        structure/shapes/dtypes slots straight in — no retrace, and the
+        cached ``decode_flops_per_step`` stays valid.  Host leaves are
+        replicated exactly as at construction.  Returns the PRIOR tree —
+        the rollback handle the rolling-rollout canary swaps back on
+        divergence.  Incompatible trees raise before anything is touched
+        (the serving tree is never left half-swapped)."""
+        import jax
+
+        new = _as_tree(params)
+        stack_params_check(new, self.config.num_hidden_layers)
+        new = jax.tree_util.tree_map(self._replicate, new)
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new)
+        if old_def != new_def:
+            raise ValueError(
+                "swap_params: new tree structure differs from the serving tree "
+                "(compiled programs are static — rebuild the engine instead)"
+            )
+        for o, n in zip(old_leaves, new_leaves):
+            if o.shape != n.shape or o.dtype != n.dtype:
+                raise ValueError(
+                    f"swap_params: leaf mismatch {n.shape}/{n.dtype} vs serving "
+                    f"{o.shape}/{o.dtype} (compiled programs are static)"
+                )
+        old, self.params = self.params, new
+        return old
+
     def _stage_bounds(self, num_stages: int) -> List[Tuple[int, int]]:
         """Contiguous layer ranges balanced by param count — the pipe
         engine's stage-split math over the decoder stack."""
@@ -559,6 +589,53 @@ class ServeEngine:
             flops = None
         self._decode_flops = flops
         return flops
+
+    def replay_greedy(self, prompt: Sequence[int], max_new_tokens: int,
+                      *, eos_id: Optional[int] = None,
+                      canary: bool = False) -> List[int]:
+        """Standalone greedy generation through the CURRENT weights on a
+        temporarily allocated slot — the rollout canary's replay
+        primitive (and the golden-baseline recorder before a swap).  The
+        slot is freed before returning, so a drained replica's cache is
+        untouched; callers must only run this while the slot can be
+        reserved (the rollout path replays after the drain, when the
+        whole pool is free).
+
+        ``canary=True`` marks a post-swap verification replay: each
+        greedy step consults the ``canary_diverge`` faultsim hook, which
+        (when armed and due) flips the sign of the step's top logit — the
+        deterministic bad-checkpoint stand-in that proves the
+        auto-rollback path without a genuinely corrupt restore."""
+        from ..resilience import faultsim as _fs
+
+        cache = self.cache
+        slot = cache.alloc(len(prompt), max_new_tokens)
+
+        def _pick(row: np.ndarray) -> int:
+            if canary and _fs.fires("canary_diverge", ctx="replay"):
+                row = np.array(row, copy=True)
+                j = int(np.argmax(row))
+                row[j] = -row[j]
+            return self.greedy(row)
+
+        try:
+            row = self.prefill(list(prompt), slot)
+            cache.commit_prefill(slot, len(prompt))
+            out: List[int] = []
+            tok = _pick(row)
+            out.append(tok)
+            for _ in range(max_new_tokens - 1):
+                if eos_id is not None and tok == eos_id:
+                    break
+                toks = np.zeros((cache.num_slots,), np.int32)
+                toks[slot] = tok
+                logits = self.decode(toks)
+                cache.advance(slot)
+                tok = _pick(logits[slot])
+                out.append(tok)
+            return out
+        finally:
+            cache.free(slot)
 
     @staticmethod
     def greedy(logits_row: np.ndarray) -> int:

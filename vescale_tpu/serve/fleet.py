@@ -132,6 +132,11 @@ def serve_replica(
                          (advisory — the authoritative verdict is the
                          ledger row ``/outcomes`` later serves)
       ``GET /outcomes``  terminal-outcome snapshot keyed by rid
+      ``POST /control``  the rolling-rollout channel: ``reload`` /
+                         ``commit`` / ``revert`` / ``status`` ops posted
+                         into a ``loop.ControlChannel`` the serve loop
+                         consumes at step boundaries (serve/autoscale.py
+                         ``RolloutController`` drives it fleet-wide)
 
     After the loop returns (drain complete), the endpoints keep
     answering for ``linger_s`` — ``/healthz`` flips to
@@ -168,12 +173,14 @@ def serve_replica(
             "counts": dict(scheduler.counts),
         }
 
+    from .loop import ControlChannel, run_serve_resilient
+
+    control = loop_kwargs.pop("control", None) or ControlChannel()
     srv = _ops.OpsServer(port=int(port))
     srv.register("submit", _submit).register("outcomes", _outcomes)
+    srv.register("control", control.provider)
     srv.start()
     try:
-        from .loop import run_serve_resilient
-
         result = run_serve_resilient(
             engine=engine,
             scheduler=scheduler,
@@ -182,6 +189,7 @@ def serve_replica(
             ops=srv,
             max_steps=max_steps,
             replica_id=rid_str,
+            control=control,
             **loop_kwargs,
         )
         # ---- linger: the drain's last completions must be harvestable
@@ -324,6 +332,64 @@ class FleetSupervisor:
                 self._event("gave_up", rid, returncode=rc, restarts=m.restarts)
 
     # ------------------------------------------------------------- control
+    def spawn_like(self, template_id: str,
+                   replica_id: Optional[str] = None) -> ReplicaSpec:
+        """Scale-up helper: clone ``template_id``'s spec onto a FRESH
+        ``testing.reserve_port`` port and a unique replica id, register
+        it, spawn it, and return the new spec (its ``.url`` is what the
+        router's ``add_replica`` needs).  Ports can never collide — the
+        reserve-port registry refuses same-process reuse — and neither
+        can ids (auto-generated ``<template>-sN`` picks the first free
+        suffix; an explicit ``replica_id`` that is already managed
+        raises).  ``restart_env_drop`` vars are dropped from the clone's
+        env up front: a transient fault schedule aimed at the original
+        fleet must not arm inside a scale-up replica."""
+        from ..testing import reserve_port
+
+        tmpl = self.managed[template_id].spec
+        if replica_id is None:
+            n = 0
+            while f"{template_id}-s{n}" in self.managed:
+                n += 1
+            replica_id = f"{template_id}-s{n}"
+        elif replica_id in self.managed:
+            raise ValueError(f"replica id {replica_id!r} already managed")
+        env = dict(tmpl.env)
+        for k in tmpl.restart_env_drop:
+            env.pop(k, None)
+        spec = ReplicaSpec(
+            replica_id,
+            tmpl.cmd,
+            reserve_port(),
+            env=env,
+            log_path=(f"{tmpl.log_path}.{replica_id}"
+                      if tmpl.log_path is not None else None),
+            restart_env_drop=tmpl.restart_env_drop,
+        )
+        m = _Managed(spec)
+        self.managed[replica_id] = m
+        self._spawn(m)
+        from .. import telemetry as _tel
+
+        _tel.count("fleet_replica_scale_ups_total")
+        self._event("spawn_like", replica_id, template=template_id,
+                    pid=m.proc.pid, port=spec.port)
+        return spec
+
+    def drain(self, replica_id: str) -> None:
+        """Non-blocking scale-down: SIGTERM now, reap from a later
+        :meth:`poll` turn.  Unlike :meth:`stop` this never waits, so the
+        autoscaler can keep pumping the router (harvesting the draining
+        replica's in-flight outcomes through its linger window) while
+        the process winds down.  Like stop, the replica is never
+        respawned."""
+        from .. import telemetry as _tel
+
+        m = self.managed[replica_id]
+        self._begin_stop(replica_id, m)
+        _tel.count("fleet_replica_scale_downs_total")
+        self._event("drain", replica_id)
+
     def kill(self, replica_id: str) -> None:
         """Simulated hard crash (SIGKILL) — the supervisor WILL respawn it
         on a later :meth:`poll` (crash semantics, unlike :meth:`stop`)."""

@@ -74,6 +74,8 @@ __all__ = [
     "backoff",
     "breaker_transition",
     "fleet_terminal",
+    "scale_event",
+    "rollout_stage",
     "FleetClockSync",
     "estimate_fleet_clock_offsets",
     "assemble_fleet_timeline",
@@ -177,6 +179,37 @@ def fleet_terminal(
     if replica is not None:
         tags["replica"] = replica
     _record(_p.FLEET_TERMINAL, time.time(), 0.0, tags)
+
+
+def scale_event(direction: str, replica: str, reason: str,
+                dur_s: float = 0.0) -> None:
+    """One autoscaler decision (``direction`` is ``up`` or ``down``) as a
+    span in the router's stream — the spawn/drain reads inline on the
+    merged timeline next to the load spike that caused it."""
+    if not is_active():
+        return
+    now = time.time()
+    _record(_p.FLEET_SCALE, now - dur_s, dur_s,
+            {"direction": direction, "replica": replica, "reason": reason})
+
+
+def rollout_stage(replica: str, stage: str, dur_s: float, ok: bool = True,
+                  reason: Optional[str] = None,
+                  checkpoint: Optional[str] = None) -> None:
+    """One weight-rollout stage (``drain`` / ``baseline`` / ``swap`` /
+    ``canary`` / ``committed`` / ``rolled_back`` / ``reverted``) as a
+    span — emitted replica-side by the serve loop's reload machine and
+    router-side by the RolloutController's fleet legs, so the whole
+    rolling rollout stitches onto one merged timeline."""
+    if not is_active():
+        return
+    now = time.time()
+    tags: Dict[str, Any] = {"replica": replica, "stage": stage, "ok": ok}
+    if reason is not None:
+        tags["reason"] = reason
+    if checkpoint is not None:
+        tags["checkpoint"] = checkpoint
+    _record(_p.FLEET_ROLLOUT, now - dur_s, dur_s, tags)
 
 
 # ------------------------------------------------------- HTTP clock sync

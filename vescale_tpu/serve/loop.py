@@ -49,6 +49,7 @@ multi-replica router.
 from __future__ import annotations
 
 import os
+import threading
 import time
 import zlib
 from dataclasses import dataclass, field
@@ -65,7 +66,7 @@ from .engine import ServeEngine
 from .obs import ServeObservability
 from .scheduler import ContinuousBatchingScheduler, Request
 
-__all__ = ["ServeResult", "run_serve_resilient"]
+__all__ = ["ControlChannel", "ServeResult", "run_serve_resilient"]
 
 # control-plane vector (fixed width): [magic, step, preempt, oom, rtimeout,
 # wall_mask, draining, then the scheduler fingerprint fields + the
@@ -95,6 +96,67 @@ class ServeResult:
     rejected_on_drain: int = 0
 
 
+class ControlChannel:
+    """Thread-safe replica control mailbox — the ``/control`` POST
+    endpoint's provider (runs on the ops HTTP thread) posts one job at a
+    time into it; the serve loop consumes at step boundaries, so weight
+    swaps only ever happen between decode steps, never mid-batch.
+
+    Ops (the rolling-rollout wire protocol; serve/autoscale.py's
+    ``RolloutController`` is the caller):
+
+      ``reload``   ``{"op": "reload", "checkpoint": path,
+                   "prompts": [[tok, ...], ...], "max_new_tokens": N,
+                   "canary": bool, "baseline": bool,
+                   "expected": [[tok, ...], ...] | null}`` — drain
+                   in-flight work, hot-swap weights from ``checkpoint``
+                   (elastic params-only restore, no process restart),
+                   then the canary stage: each pinned golden prompt is
+                   replayed TWICE through the fresh weights (the two
+                   streams must be bit-identical — the determinism
+                   check that catches ``canary_diverge``) and, when
+                   ``expected`` is given, both must equal it (the
+                   cross-replica consistency check).  ``baseline``
+                   computes ``expected`` from the OLD weights pre-swap
+                   (the checkpoint-equivalence rollout).  Divergence
+                   swaps the old weights straight back
+                   (``rolled_back``); a pass parks them in-process
+                   (``committed``, two-phase) until ``commit``/``revert``.
+      ``commit``   drop the retained old tree — the fleet-wide rollout
+                   succeeded, this replica's rollback leg is closed.
+      ``revert``   drain, swap the retained old tree back in —
+                   another replica's canary diverged, roll back.
+      ``status``   read the live rollout state (also on /router v5).
+
+    Posting while a job is pending returns ``{"ok": false, "error":
+    "busy"}`` — the controller retries after the in-flight stage lands.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._job: Optional[Dict[str, Any]] = None
+        self.state: Optional[Dict[str, Any]] = None  # mirror of obs.rollout
+
+    def provider(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        op = payload.get("op")
+        if op == "status":
+            return {"ok": True, "rollout": self.state}
+        if op in ("reload", "commit", "revert"):
+            if op == "reload" and not payload.get("checkpoint"):
+                return {"ok": False, "error": "reload needs a checkpoint path"}
+            with self._lock:
+                if self._job is not None:
+                    return {"ok": False, "error": "busy", "rollout": self.state}
+                self._job = dict(payload)
+            return {"ok": True, "accepted": op}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def take(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            job, self._job = self._job, None
+            return job
+
+
 def run_serve_resilient(
     *,
     engine: ServeEngine,
@@ -114,6 +176,7 @@ def run_serve_resilient(
     idle_sleep_s: Optional[float] = None,
     replica_id: Optional[str] = None,
     speculative: Optional[Any] = None,
+    control: Optional[ControlChannel] = None,
 ) -> ServeResult:
     """Serve ``arrivals`` (a deterministic open-loop schedule of
     ``(arrival_step, Request)`` pairs, ascending) to completion under the
@@ -149,6 +212,20 @@ def run_serve_resilient(
     emitted stream BITWISE identical to plain decode, so both multipliers
     compose with every fault above (an evicted request's replay re-hits
     the tree; rejected draft tokens roll back uncommitted).
+
+    Rolling weight rollout (``control``, a :class:`ControlChannel` —
+    serve/fleet.py wires it to the ``/control`` endpoint): ``reload``
+    jobs run the drain -> [baseline] -> swap -> canary ->
+    committed | rolled_back machine at step boundaries — admission pauses
+    (the /router feed drops ``accepting``) while in-flight requests
+    decode out through the OLD weights, the fresh checkpoint is restored
+    params-only in-process (``serve.load_params`` +
+    ``ServeEngine.swap_params`` — the compiled programs take params as an
+    argument, so no recompile), pinned golden prompts replay through the
+    new weights, and any divergence swaps the old tree straight back.
+    Single-process replicas only (fleet mode): nothing coordinates a
+    reload across ranks, so a ``coordinate=True`` loop must not be given
+    a control channel.
     """
     import jax
 
@@ -186,10 +263,19 @@ def run_serve_resilient(
         if wd is not None:
             wd.beat(step, phase=phase)
 
+    if coord and control is not None:
+        raise ValueError(
+            "the /control reload machine is single-process (fleet mode): "
+            "nothing coordinates a weight swap across ranks"
+        )
+
     arrivals = sorted(arrivals, key=lambda p: (p[0], p[1].rid))
     next_arrival = 0
     token_crc = 0  # running digest of every sampled token (desync tripwire)
     draining = False
+    reload_job: Optional[Dict[str, Any]] = None  # the in-flight /control job
+    reload_t0 = 0.0  # when its drain began (the drain span's start)
+    retained_params = None  # old tree parked by a committed swap (two-phase)
     result = ServeResult(status="completed")
     cache = scheduler.cache
 
@@ -271,6 +357,116 @@ def run_serve_resilient(
 
     def _event(kind: str, **fields) -> None:
         _tel.record_event(f"serve_{kind}", **fields)
+
+    # ------------------------------------------------- rollout machine
+    from . import fleettrace as _ftrace
+
+    def _rollout_state(state: str, step: int, **detail) -> None:
+        """Publish the live rollout stage everywhere at once: the /router
+        v5 ``rollout`` field, the /control ``status`` reply, and a
+        ``serve_rollout_<state>`` event."""
+        snap = {
+            "state": state,
+            "checkpoint": (reload_job or {}).get("checkpoint"),
+            "detail": detail,
+        }
+        obs.rollout = snap
+        if control is not None:
+            control.state = snap
+        _event(
+            f"rollout_{state}", at_step=step,
+            **{k: v for k, v in detail.items() if not isinstance(v, (list, dict))},
+        )
+
+    def _perform_reload(step: int) -> None:
+        """The post-drain half of a /control job, run AT a step boundary
+        with zero in-flight requests: [baseline ->] swap -> canary ->
+        committed | rolled_back for ``reload``; instant park-drop for
+        ``commit``; swap-back for ``revert``.  Queued requests stay
+        queued throughout and decode through whichever tree survives."""
+        nonlocal retained_params
+        job = reload_job
+        rep = obs.replica_id
+        op = job.get("op", "reload")
+        if op == "commit":
+            finalized = retained_params is not None
+            retained_params = None  # the fleet-wide rollout stuck: drop
+            _rollout_state("committed", step, finalized=finalized)
+            return
+        if op == "revert":
+            if retained_params is None:
+                _rollout_state("rolled_back", step, reverted=False,
+                               reason="nothing retained")
+                return
+            t0 = time.perf_counter()
+            engine.swap_params(retained_params)
+            retained_params = None
+            _tel.count("serve_rollbacks_total")
+            _ftrace.rollout_stage(rep, "reverted", time.perf_counter() - t0)
+            _rollout_state("rolled_back", step, reverted=True)
+            return
+        # ------------------------------------------------- op == reload
+        from . import load_params as _load_params
+
+        ckpt = job["checkpoint"]
+        prompts = [[int(t) for t in p] for p in (job.get("prompts") or [])]
+        mnt = max(1, int(job.get("max_new_tokens") or 8))
+        canary = bool(job.get("canary", True)) and bool(prompts)
+        expected = job.get("expected")
+        _tel.count("serve_rollouts_total")
+        if canary and expected is None and job.get("baseline"):
+            # checkpoint-equivalence rollout: the OLD weights' streams
+            # are the reference the new weights must reproduce bitwise
+            _rollout_state("baseline", step, prompts=len(prompts))
+            b0 = time.perf_counter()
+            expected = [engine.replay_greedy(p, mnt) for p in prompts]
+            _ftrace.rollout_stage(rep, "baseline", time.perf_counter() - b0,
+                                  checkpoint=ckpt)
+        _rollout_state("swapping", step)
+        s0 = time.perf_counter()
+        try:
+            old = engine.swap_params(_load_params(ckpt, engine.params))
+        except Exception as e:  # unreadable/mismatched checkpoint: no swap
+            why = f"restore failed: {e}"
+            _ftrace.rollout_stage(rep, "swap", time.perf_counter() - s0,
+                                  ok=False, reason=why, checkpoint=ckpt)
+            _tel.count("serve_rollbacks_total")
+            _rollout_state("rolled_back", step, reason=why)
+            return
+        _ftrace.rollout_stage(rep, "swap", time.perf_counter() - s0,
+                              checkpoint=ckpt)
+        ok, why, streams = True, "", []
+        if canary:
+            _rollout_state("canary", step, prompts=len(prompts))
+            c0 = time.perf_counter()
+            for p in prompts:
+                s1 = engine.replay_greedy(p, mnt, canary=True)
+                s2 = engine.replay_greedy(p, mnt, canary=True)
+                if ok and s1 != s2:
+                    # the determinism check: one replay's flipped logit
+                    # (faultsim canary_diverge, or real nondeterminism)
+                    # cannot reproduce, so the twin replays disagree
+                    ok, why = False, "canary replay not deterministic"
+                streams.append(s1)
+            if ok and expected is not None:
+                exp = [[int(t) for t in s] for s in expected]
+                if exp != streams:
+                    ok, why = False, "canary streams diverged from expected"
+            _ftrace.rollout_stage(rep, "canary", time.perf_counter() - c0,
+                                  ok=ok, reason=why or None, checkpoint=ckpt)
+        if ok:
+            # two-phase: park the old tree until the controller's fleet-
+            # wide commit (or revert, if a LATER replica's canary fails)
+            retained_params = old
+            _ftrace.rollout_stage(rep, "committed", 0.0, checkpoint=ckpt)
+            _rollout_state("committed", step, finalized=False,
+                           streams=streams, canary=canary)
+        else:
+            engine.swap_params(old)
+            _tel.count("serve_rollbacks_total")
+            _ftrace.rollout_stage(rep, "rolled_back", 0.0, ok=False,
+                                  reason=why, checkpoint=ckpt)
+            _rollout_state("rolled_back", step, reason=why, streams=streams)
 
     def _coordinate(step: int, oom_fired: bool, rt_fired: bool,
                     wall_mask: int) -> Tuple[bool, bool, bool, int]:
@@ -373,7 +569,18 @@ def run_serve_resilient(
             # queue-wait component was observed at admission (scheduler);
             # this is the rest — the decomposition's prefill half
             ttft = now - inf.submit_wall
-            scheduler.observe_ttft(ttft)
+            # per-tenant TTFT rides along once tenants are in play (a
+            # non-default class, or weights configured); the zero-config
+            # single-tenant path observes exactly what it always did
+            tenant = inf.req.tenant
+            scheduler.observe_ttft(
+                ttft,
+                tenant=(
+                    tenant
+                    if (tenant != "default" or scheduler.tenant_weights)
+                    else None
+                ),
+            )
             _tel.observe("serve_ttft_prefill_seconds", prefill_s)
             _event("admit", rid=inf.req.rid, slot=inf.slot, at_step=step,
                    replays=inf.replays, ttft_s=round(ttft, 6))
@@ -444,6 +651,28 @@ def run_serve_resilient(
                         _tel.count("serve_inbox_rejected_total")
                         _event("inbox_reject", rid=getattr(req, "rid", -1),
                                at_step=step, error=str(e))
+
+            # -------------------------------------------- weight rollout
+            if control is not None:
+                if reload_job is None:
+                    reload_job = control.take()
+                    if reload_job is not None:
+                        reload_t0 = time.perf_counter()
+                        if reload_job.get("op", "reload") != "commit":
+                            # admission pauses from here (the /router feed
+                            # drops `accepting`); in-flight decodes out
+                            _rollout_state("draining", step,
+                                           inflight=len(scheduler.active))
+                if reload_job is not None:
+                    op = reload_job.get("op", "reload")
+                    if op == "commit" or not scheduler.active:
+                        if op != "commit":
+                            _ftrace.rollout_stage(
+                                obs.replica_id, "drain",
+                                time.perf_counter() - reload_t0,
+                            )
+                        _perform_reload(step)
+                        reload_job = None
 
             # ------------------------------------------- control plane
             # wall-deadline verdicts are rank-LOCAL clock reads: compute
@@ -522,7 +751,7 @@ def run_serve_resilient(
                 # free drafter slots whose target terminated since the
                 # last boundary BEFORE admission can reuse the slot ids
                 speculative.sync_slots(scheduler.active)
-            if not draining:
+            if not draining and reload_job is None:
                 _prefill_admitted(step)
                 # the prefill-sampled token may already satisfy the request
                 # (max_new_tokens=1, or EOS on the first token): complete it

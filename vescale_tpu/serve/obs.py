@@ -38,14 +38,17 @@ __all__ = [
     "ROUTER_FIELDS_V1",
     "ROUTER_FIELDS_V2",
     "ROUTER_FIELDS_V3",
+    "ROUTER_FIELDS_V4",
     "FLEET_SCHEMA_VERSION",
     "FLEET_FIELDS",
     "FLEET_FIELDS_V2",
+    "FLEET_FIELDS_V3",
     "FLEET_REPLICA_FIELDS",
     "FLEET_REPLICA_FIELDS_V1",
+    "FLEET_REPLICA_FIELDS_V2",
 ]
 
-ROUTER_SCHEMA_VERSION = 4
+ROUTER_SCHEMA_VERSION = 5
 # the frozen /router v1 field set: the freeze contract says fields are
 # only ever ADDED — v1 must remain a strict subset of every later version
 # (tests assert it), so a router written against v1 keeps working
@@ -92,14 +95,23 @@ ROUTER_FIELDS_V3 = ROUTER_FIELDS_V2 | frozenset(("prefix_hit_rate", "spec_accept
 # router already polls — no second probe.  The full lifecycle snapshot
 # (frozen schema v1) lives on `/alerts`; this is the inline summary.
 # docs/serving.md documents the v3 -> v4 delta.
-ROUTER_FIELDS = ROUTER_FIELDS_V3 | frozenset(("alerts",))
+ROUTER_FIELDS_V4 = ROUTER_FIELDS_V3 | frozenset(("alerts",))
+# schema v5 (additive again): `tenants` — per-tenant SLO-class stats
+# (submitted/shed/completed/queue_depth/weight/cap/ttft_p99_s per tenant;
+# {} until a non-default tenant submits) — and `rollout` — the replica's
+# live weight-rollout state (null outside a rollout; during one, the
+# {"state", "checkpoint", "detail"} dict the loop's reload machine
+# maintains: draining -> baseline -> swapping -> canary ->
+# committed | rolled_back).  The fleet rollout controller polls this
+# instead of guessing from /healthz.  docs/serving.md has the delta.
+ROUTER_FIELDS = ROUTER_FIELDS_V4 | frozenset(("tenants", "rollout"))
 
 # the router-side `/fleet` rollup schema, frozen under the same contract
 # as ROUTER_FIELDS (fields only ever added, asserted at the source and by
 # tests): the live view an operator — or ROADMAP item 2's auto-plan
 # search — reads to decide a replica is degrading before its breaker
 # trips.  docs/serving.md documents every field.
-FLEET_SCHEMA_VERSION = 3
+FLEET_SCHEMA_VERSION = 4
 FLEET_FIELDS_V2 = frozenset(
     (
         "schema_version",
@@ -122,7 +134,13 @@ FLEET_FIELDS_V2 = frozenset(
 # alert-engine digest (fleet-scope rules: fleet-shed-rate,
 # fleet-no-healthy-replicas, fleet-ttft-slo-burn), same
 # {"active", "firing", "pending"} shape as /router v4.
-FLEET_FIELDS = FLEET_FIELDS_V2 | frozenset(("alerts",))
+FLEET_FIELDS_V3 = FLEET_FIELDS_V2 | frozenset(("alerts",))
+# fleet schema v4 (additive): `queue_depth` — router-pending plus the sum
+# of replica queue depths, the autoscaler's load-trend input published as
+# the `fleet_timeline_queue_depth` gauge — `tenants` — the per-tenant
+# stats summed across replica feeds — and `autoscale` — the attached
+# Autoscaler's state snapshot (null until serve.autoscale attaches one).
+FLEET_FIELDS = FLEET_FIELDS_V3 | frozenset(("queue_depth", "tenants", "autoscale"))
 # per-replica row of the `/fleet` feed (frozen with the outer schema)
 FLEET_REPLICA_FIELDS_V1 = frozenset(
     (
@@ -143,9 +161,12 @@ FLEET_REPLICA_FIELDS_V1 = frozenset(
 )
 # fleet schema v2 (additive, rides the /router v3 fields straight
 # through): the per-replica cache-warmth columns of the aggregate view
-FLEET_REPLICA_FIELDS = FLEET_REPLICA_FIELDS_V1 | frozenset(
+FLEET_REPLICA_FIELDS_V2 = FLEET_REPLICA_FIELDS_V1 | frozenset(
     ("prefix_hit_rate", "spec_accept_rate")
 )
+# per-replica v3 (rides /router v5 through): the replica's live rollout
+# state, so one /fleet poll shows which stage every replica is in
+FLEET_REPLICA_FIELDS = FLEET_REPLICA_FIELDS_V2 | frozenset(("rollout",))
 
 
 def _alerts_digest() -> Dict:
@@ -185,6 +206,10 @@ class ServeObservability:
             or f"rank{self.rank}"
         )
         self.draining = False  # the loop flips it; /healthz reports it
+        # the loop's reload machine owns this: None outside a rollout,
+        # else {"state", "checkpoint", "detail"} (/router v5 passes it
+        # through; the fleet rollout controller polls it)
+        self.rollout: Optional[Dict] = None
         self.serve_step = 0
         self.decode_steps = 0
         self._start = time.perf_counter()
@@ -329,14 +354,23 @@ class ServeObservability:
         submitted = max(1, sched.counts["submitted"])
         prefix = getattr(sched, "prefix", None)
         spec = self.speculative
+        ro = self.rollout
+        rollout_busy = ro is not None and ro.get("state") in (
+            "draining", "baseline", "swapping", "canary"
+        )
         out = {
             "schema_version": ROUTER_SCHEMA_VERSION,
             "rank": self.rank,
             "replica_id": self.replica_id,
             "draining": self.draining,
-            # the pre-dispatch exclusion signal: False while draining OR
-            # while admission control would shed a submission right now
-            "accepting": not self.draining and sched.currently_shedding() is None,
+            # the pre-dispatch exclusion signal: False while draining,
+            # while admission control would shed a submission right now,
+            # OR while the reload machine holds admission for a rollout
+            "accepting": (
+                not self.draining
+                and not rollout_busy
+                and sched.currently_shedding() is None
+            ),
             "queue_depth": len(sched.queue),
             "inflight": len(sched.active),
             "slots": cache.num_slots,
@@ -361,6 +395,9 @@ class ServeObservability:
             # v4: the alert-engine digest ({"active": false, ...} while
             # dormant) — degradation signal ahead of the breaker
             "alerts": _alerts_digest(),
+            # v5: per-tenant SLO-class stats + live rollout state
+            "tenants": sched.tenant_stats(),
+            "rollout": self.rollout,
         }
         assert set(out) == ROUTER_FIELDS  # the freeze, enforced at source
         return out
@@ -390,6 +427,9 @@ class FleetObservability:
         if slo_ttft_s is None:
             slo_ttft_s = envreg.get_float("VESCALE_SERVE_SLO_TTFT_S") or 0.0
         self.slo_ttft_s = float(slo_ttft_s)
+        # serve.autoscale.Autoscaler attaches its state callable here so
+        # /fleet v4 carries the control loop's view (null until attached)
+        self.autoscale_provider = None
         self._start = time.perf_counter()
 
     # ------------------------------------------------------------ rollups
@@ -424,6 +464,20 @@ class FleetObservability:
         )
         counts = self.router.ledger.counts
         shed_rate = counts["shed"] / max(1, counts["submitted"])
+        # the autoscaler's load-trend input: work waiting ANYWHERE in the
+        # fleet — router-pending plus every replica's local queue
+        queue_depth = self.router.ledger.pending_count() + sum(
+            int(f.get("queue_depth") or 0) for f in feeds.values()
+        )
+        # per-tenant stats summed across feeds (absent pre-v5 feeds -> {})
+        tenants: Dict[str, Dict] = {}
+        for f in feeds.values():
+            for t, row in (f.get("tenants") or {}).items():
+                agg = tenants.setdefault(
+                    t, {"submitted": 0, "shed": 0, "completed": 0, "queue_depth": 0}
+                )
+                for k in agg:
+                    agg[k] += int(row.get(k) or 0)
         return {
             "feeds": feeds,
             "goodput": goodput,
@@ -432,6 +486,8 @@ class FleetObservability:
             "ttft_p99": ttft_p99,
             "burn": burn,
             "shed_rate": shed_rate,
+            "queue_depth": queue_depth,
+            "tenants": tenants,
         }
 
     def fleet(self) -> Dict:
@@ -462,6 +518,8 @@ class FleetObservability:
                 # (absent from an old replica's v2 feed -> null)
                 "prefix_hit_rate": f.get("prefix_hit_rate"),
                 "spec_accept_rate": f.get("spec_accept_rate"),
+                # v3: the replica's live rollout stage (/router v5)
+                "rollout": f.get("rollout"),
             }
             assert set(row) == FLEET_REPLICA_FIELDS  # frozen at source
             replicas[h.id] = row
@@ -484,6 +542,12 @@ class FleetObservability:
             "uptime_s": round(time.perf_counter() - self._start, 6),
             # v3: the router process's own alert digest (fleet-scope rules)
             "alerts": _alerts_digest(),
+            # v4: aggregate load, per-tenant rollup, autoscaler state
+            "queue_depth": r["queue_depth"],
+            "tenants": r["tenants"],
+            "autoscale": (
+                self.autoscale_provider() if self.autoscale_provider else None
+            ),
         }
         assert set(out) == FLEET_FIELDS  # the freeze, enforced at source
         return out
@@ -527,6 +591,13 @@ class FleetObservability:
         if r["burn"] is not None:
             _tel.set_gauge("fleet_timeline_slo_burn_rate", r["burn"])
         _tel.set_gauge("fleet_timeline_shed_rate", r["shed_rate"])
+        # the autoscaler's two control inputs, published every poll so
+        # the time-series store can trend them: total queued work and the
+        # dispatchable replica count it scales against
+        _tel.set_gauge("fleet_timeline_queue_depth", r["queue_depth"])
+        _tel.set_gauge(
+            "fleet_timeline_replica_count", len(self.router.replicas)
+        )
         for rid, f in r["feeds"].items():
             if f.get("shed_rate") is not None:
                 _tel.set_gauge(f"fleet_timeline_shed_rate_{rid}", f["shed_rate"])

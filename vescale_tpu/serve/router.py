@@ -138,6 +138,10 @@ def request_payload(
         tag = req.tag
     if tag is not None:
         d["tag"] = tag
+    if req.tenant != "default":
+        # additive wire field: default-tenant payloads are byte-identical
+        # to the pre-tenant wire, so old replicas still parse them
+        d["tenant"] = req.tenant
     return d
 
 
@@ -154,6 +158,7 @@ def request_from_payload(d: Dict[str, Any]) -> Request:
             None if d.get("deadline_steps") is None else int(d["deadline_steps"])
         ),
         tag=(None if d.get("tag") is None else int(d["tag"])),
+        tenant=str(d.get("tenant") or "default"),
     )
 
 
@@ -464,9 +469,17 @@ class HttpReplicaClient:
         return self._get("/outcomes")
 
     def submit(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        return self._post("/submit", payload)
+
+    def control(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """The rollout control hop: POST ``/control`` (``reload`` /
+        ``status`` ops — serve/fleet.py registers the provider)."""
+        return self._post("/control", payload)
+
+    def _post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         body = json.dumps(payload).encode()
         req = urllib.request.Request(
-            f"{self.base_url}/submit", data=body,
+            f"{self.base_url}{path}", data=body,
             headers={"Content-Type": "application/json"}, method="POST",
         )
         try:
@@ -474,7 +487,7 @@ class HttpReplicaClient:
                 self._capture_retry_after(resp)
                 return json.loads(resp.read().decode())
         except Exception as e:
-            raise ReplicaUnreachable(f"POST /submit on {self.base_url}: {e}") from e
+            raise ReplicaUnreachable(f"POST {path} on {self.base_url}: {e}") from e
 
 
 class _Replica:

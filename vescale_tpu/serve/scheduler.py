@@ -47,6 +47,32 @@ __all__ = ["Request", "ShedError", "ContinuousBatchingScheduler"]
 TERMINAL = ("completed", "shed", "timed_out", "preempted_requeue")
 
 
+def _safe(name: str) -> str:
+    """Metric-name-safe tenant slug (the alerts module's convention)."""
+    return "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+
+
+def _tenant_weights_from_env() -> Dict[str, float]:
+    """Parse ``VESCALE_SERVE_TENANT_WEIGHTS`` — ``"tenant:weight"`` pairs,
+    comma-separated (``"paid:3,free:1"``).  Empty/unset means the
+    weight-aware admission gate is OFF.  Malformed values raise: a
+    silently-dropped SLO class is worse than a crash at construction."""
+    from ..analysis import envreg
+
+    raw = envreg.get_str("VESCALE_SERVE_TENANT_WEIGHTS")
+    if not raw:
+        return {}
+    out: Dict[str, float] = {}
+    for part in raw.split(","):
+        name, sep, w = part.strip().partition(":")
+        if not sep or not name:
+            raise ValueError(
+                f"VESCALE_SERVE_TENANT_WEIGHTS: expected tenant:weight, got {part!r}"
+            )
+        out[name] = float(w)
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class Request:
     """One generation request.  ``deadline_steps`` is relative to the
@@ -65,12 +91,18 @@ class Request:
     eos_id: Optional[int] = None
     deadline_steps: Optional[int] = None
     tag: Optional[int] = None
+    # SLO class (per-tenant accounting + weight-aware shedding): requests
+    # without one land in the "default" class, so single-tenant callers
+    # never see the field
+    tenant: str = "default"
 
     def __post_init__(self):
         if not self.prompt:
             raise ValueError(f"request {self.rid}: empty prompt")
         if self.max_new_tokens < 1:
             raise ValueError(f"request {self.rid}: max_new_tokens must be >= 1")
+        if not self.tenant:
+            raise ValueError(f"request {self.rid}: tenant must be a non-empty string")
 
 
 class ShedError(RuntimeError):
@@ -116,6 +148,7 @@ class ContinuousBatchingScheduler:
         slo_ttft_s: Optional[float] = None,
         ttft_window: int = 256,
         prefix_cache: Optional["PrefixCache"] = None,
+        tenant_weights: Optional[Dict[str, float]] = None,
     ):
         from ..analysis import envreg
         from ..telemetry.registry import Histogram
@@ -165,6 +198,29 @@ class ContinuousBatchingScheduler:
             "requeued": 0,
             "resubmitted": 0,
         }
+        # ---- per-tenant SLO classes.  With weights configured (arg or
+        # VESCALE_SERVE_TENANT_WEIGHTS "tenant:weight,..."), admission
+        # becomes weight-aware: a tenant whose queued share exceeds its
+        # weighted slice of max_queue sheds FIRST, before the global
+        # limits touch anyone else.  Unconfigured (None/empty) the gate
+        # is entirely off — single-tenant behavior is bit-identical.
+        if tenant_weights is None:
+            tenant_weights = _tenant_weights_from_env()
+        self.tenant_weights: Dict[str, float] = dict(tenant_weights or {})
+        for t, w in self.tenant_weights.items():
+            if w <= 0:
+                raise ValueError(f"tenant {t!r}: weight must be > 0, got {w}")
+        # per-tenant accounting exists regardless of weights: counters and
+        # a TTFT histogram per observed class (lazily created; the rollup
+        # rides the /router v5 feed)
+        self.tenant_counts: Dict[str, Dict[str, int]] = {}
+        self._tenant_ttft: Dict[str, Any] = {}
+        # queue depth per tenant, maintained INCREMENTALLY at every queue
+        # mutation: the weight-aware shed check runs per submit and must
+        # cost O(1), never a queue scan
+        self._tenant_qdepth: Dict[str, int] = {}
+        self._tenant_cap_cache: Dict[str, Optional[int]] = {}
+        self._ttft_window = ttft_window
         # event-sourced digest: every scheduling decision folds into a
         # running crc so fingerprint() is O(1) per step boundary (the
         # control-plane exchange must cost << a decode step)
@@ -175,12 +231,92 @@ class ContinuousBatchingScheduler:
             b"".join((v & 0xFFFFFFFF).to_bytes(4, "little") for v in ints), self._digest
         )
 
+    # ------------------------------------------------------------- tenants
+    def _tenant_counts(self, tenant: str) -> Dict[str, int]:
+        counts = self.tenant_counts.get(tenant)
+        if counts is None:
+            counts = self.tenant_counts[tenant] = {
+                "submitted": 0, "shed": 0, "completed": 0,
+            }
+        return counts
+
+    def _tenant_observe_ttft(self, tenant: str, seconds: float) -> None:
+        from .. import telemetry as _tel
+        from ..telemetry.registry import Histogram
+
+        hist = self._tenant_ttft.get(tenant)
+        if hist is None:
+            hist = self._tenant_ttft[tenant] = Histogram(
+                f"serve_ttft_seconds_tenant_{_safe(tenant)}",
+                window=self._ttft_window,
+            )
+        hist.observe(seconds)
+        _tel.observe(f"serve_ttft_seconds_tenant_{_safe(tenant)}", seconds)
+
+    def tenant_queue_depth(self, tenant: str) -> int:
+        return self._tenant_qdepth.get(tenant, 0)
+
+    def _tq(self, tenant: str, delta: int) -> None:
+        d = self._tenant_qdepth.get(tenant, 0) + delta
+        if d:
+            self._tenant_qdepth[tenant] = d
+        else:
+            self._tenant_qdepth.pop(tenant, None)
+
+    def tenant_cap(self, tenant: str) -> Optional[int]:
+        """The weighted queue slice a tenant may hold before it sheds
+        (None = no weights configured, gate off).  An UNLISTED tenant
+        weighs 1.0 against the configured classes — naming only the paid
+        class still deprioritizes everyone else deterministically."""
+        if not self.tenant_weights or not self.max_queue:
+            return None
+        if tenant in self._tenant_cap_cache:  # weights are ctor-frozen
+            return self._tenant_cap_cache[tenant]
+        w = float(self.tenant_weights.get(tenant, 1.0))
+        total = sum(self.tenant_weights.values())
+        if tenant not in self.tenant_weights:
+            total += 1.0
+        cap = max(1, int(self.max_queue * w / total))
+        self._tenant_cap_cache[tenant] = cap
+        return cap
+
+    def _tenant_shed_reason(self, req: Request) -> Optional[str]:
+        cap = self.tenant_cap(req.tenant)
+        if cap is not None and self.tenant_queue_depth(req.tenant) >= cap:
+            return (
+                f"tenant {req.tenant} over weighted queue share "
+                f"({self.tenant_queue_depth(req.tenant)}/{cap})"
+            )
+        return None
+
+    def tenant_stats(self) -> Dict[str, Dict[str, Any]]:
+        """The per-tenant rollup the `/router` v5 feed carries: counters,
+        live queue depth, weighted cap, and the class's own p99 TTFT (the
+        burn-rate rules' per-class denominator)."""
+        tenants = set(self.tenant_counts) | set(self._tenant_qdepth)
+        out: Dict[str, Dict[str, Any]] = {}
+        for t in sorted(tenants):
+            counts = self._tenant_counts(t)
+            hist = self._tenant_ttft.get(t)
+            out[t] = {
+                "submitted": counts["submitted"],
+                "shed": counts["shed"],
+                "completed": counts["completed"],
+                "queue_depth": self.tenant_queue_depth(t),
+                "weight": float(self.tenant_weights.get(t, 1.0)),
+                "cap": self.tenant_cap(t),
+                "ttft_p99_s": hist.percentile(0.99) if hist is not None else None,
+            }
+        return out
+
     # ------------------------------------------------------------- metrics
-    def observe_ttft(self, seconds: float) -> None:
+    def observe_ttft(self, seconds: float, tenant: Optional[str] = None) -> None:
         from .. import telemetry as _tel
 
         self._ttft.observe(seconds)
         _tel.observe("serve_ttft_seconds", seconds)
+        if tenant is not None:
+            self._tenant_observe_ttft(tenant, seconds)
 
     def observe_step_time(self, seconds: float) -> None:
         from .. import telemetry as _tel
@@ -261,8 +397,15 @@ class ContinuousBatchingScheduler:
             self.counts["resubmitted"] += 1
             self._fold(17, req.rid, step)
         self.counts["submitted"] += 1
+        tcounts = self._tenant_counts(req.tenant)
+        tcounts["submitted"] += 1
+        _tel.count(f"serve_tenant_{_safe(req.tenant)}_submitted_total")
         reqtrace.submit(req.rid, step, tag=req.tag)
         reason = self.currently_shedding()
+        tenant_shed = False
+        if reason is None:
+            reason = self._tenant_shed_reason(req)
+            tenant_shed = reason is not None
         total = len(req.prompt) + req.max_new_tokens
         if reason is None and total > self.cache.max_seq_len:
             reason = (
@@ -279,6 +422,7 @@ class ContinuousBatchingScheduler:
         if reason is not None:
             retry = self.retry_after_s()
             self.counts["shed"] += 1
+            tcounts["shed"] += 1
             self.outcomes[req.rid] = {
                 "status": "shed",
                 "reason": reason,
@@ -288,14 +432,21 @@ class ContinuousBatchingScheduler:
             }
             _tel.count("serve_requests_shed_total")
             _tel.count("resilience_shed_total")
+            _tel.count(f"serve_tenant_{_safe(req.tenant)}_shed_total")
             _tel.record_event("serve_shed", rid=req.rid, reason=reason, retry_after_s=retry)
             reqtrace.terminal(req.rid, "shed", 0, reason=reason)
             self._fold(10, req.rid, step)
+            if tenant_shed:
+                # the weight-aware decision depends on the tenant-weights
+                # config: fold it separately so a rank armed with a
+                # different weight table desyncs BEFORE batches fork
+                self._fold(20, req.rid, step)
             if raise_on_shed:
                 raise ShedError(req.rid, reason, retry)
             return False
         self._fold(11, req.rid, step)
         self.queue.append((req, step, time.perf_counter()))
+        self._tq(req.tenant, +1)
         _tel.set_gauge("serve_queue_depth", len(self.queue))
         return True
 
@@ -325,6 +476,7 @@ class ContinuousBatchingScheduler:
                     break
                 slot = self.cache.alloc(len(req.prompt), req.max_new_tokens)
             self.queue.popleft()
+            self._tq(req.tenant, -1)
             inf = _InFlight(req=req, slot=slot, submit_step=submit_step,
                             admit_step=step, submit_wall=submit_wall,
                             prefix_hit=matched)
@@ -369,12 +521,14 @@ class ContinuousBatchingScheduler:
         inf = self.active.pop(slot)
         self.cache.free(slot)
         self.counts["completed"] += 1
+        self._tenant_counts(inf.req.tenant)["completed"] += 1
         # goodput: only tokens that reached a COMPLETED terminal count
         self.goodput_tokens += len(inf.tokens)
         self._fold(13, inf.req.rid, slot, len(inf.tokens))
         self._terminal(inf, "completed")
         reqtrace.terminal(inf.req.rid, "completed", len(inf.tokens), slot=slot)
         _tel.count("serve_requests_completed_total")
+        _tel.count(f"serve_tenant_{_safe(inf.req.tenant)}_completed_total")
         _tel.count("serve_goodput_tokens_total", len(inf.tokens))
         _tel.set_gauge("serve_inflight", len(self.active))
         return self.outcomes[inf.req.rid]
@@ -421,6 +575,7 @@ class ContinuousBatchingScheduler:
                 _tel.record_event("serve_timeout", rid=req.rid,
                                   reason="queued past deadline")
                 expired.append(req.rid)
+                self._tq(req.tenant, -1)
             else:
                 keep.append((req, submit_step, submit_wall))
         self.queue = keep
@@ -465,6 +620,7 @@ class ContinuousBatchingScheduler:
         # the ORIGINAL submit stamps ride along: the replayed request's
         # TTFT honestly includes everything since the client submitted
         self.queue.appendleft((inf.req, inf.submit_step, inf.submit_wall))
+        self._tq(inf.req.tenant, +1)
         # the fork marker: this rid's chain re-runs queue-wait -> prefill
         reqtrace.evict(inf.req.rid, slot, reason, replays=inf.replays + 1)
         _tel.count("serve_requests_evicted_total")
@@ -481,6 +637,7 @@ class ContinuousBatchingScheduler:
         rejected = []
         while self.queue:
             req, _, _ = self.queue.popleft()
+            self._tq(req.tenant, -1)
             self._fold(16, req.rid)
             self.outcomes[req.rid] = {
                 "status": "preempted_requeue",

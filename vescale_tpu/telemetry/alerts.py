@@ -119,20 +119,30 @@ _OPS: Dict[str, Callable[[float, float], bool]] = {
 # -------------------------------------------------------------------- rules
 class Rule:
     """Base declarative rule: subclasses implement :meth:`condition`
-    returning ``(condition_holds, observed_value)`` over the store."""
+    returning ``(condition_holds, observed_value)`` over the store.
+
+    ``for_s`` holds the rule PENDING that long before firing;
+    ``resolve_for_s`` is the symmetric hysteresis on the way down — a
+    firing rule must stay below threshold that long before ok-ing (the
+    anti-flap hold consumers like the autoscaler key off: a noisy burn
+    signal that dips for one sample must not read as recovered)."""
 
     kind = "rule"
 
     def __init__(self, name: str, severity: str = "warning",
-                 message: str = "", for_s: float = 0.0):
+                 message: str = "", for_s: float = 0.0,
+                 resolve_for_s: float = 0.0):
         if severity not in SEVERITIES:
             raise ValueError(f"rule {name!r}: severity must be one of {SEVERITIES}")
         if for_s < 0:
             raise ValueError(f"rule {name!r}: for_s must be >= 0")
+        if resolve_for_s < 0:
+            raise ValueError(f"rule {name!r}: resolve_for_s must be >= 0")
         self.name = name
         self.severity = severity
         self.message = message
         self.for_s = float(for_s)
+        self.resolve_for_s = float(resolve_for_s)
 
     def condition(self, store, now: float) -> Tuple[bool, Optional[float]]:
         raise NotImplementedError
@@ -146,8 +156,9 @@ class ThresholdRule(Rule):
     def __init__(self, name: str, metric: str, op: str, threshold: float,
                  window_s: float = 60.0, reducer: str = "last",
                  for_s: float = 0.0, severity: str = "warning",
-                 message: str = ""):
-        super().__init__(name, severity=severity, message=message, for_s=for_s)
+                 message: str = "", resolve_for_s: float = 0.0):
+        super().__init__(name, severity=severity, message=message, for_s=for_s,
+                         resolve_for_s=resolve_for_s)
         if op not in _OPS:
             raise ValueError(f"rule {name!r}: op must be one of {sorted(_OPS)}")
         self.metric = metric
@@ -182,8 +193,9 @@ class BurnRateRule(Rule):
                      (21600.0, 1800.0, 6.0),
                  ),
                  for_s: float = 0.0, severity: str = "critical",
-                 message: str = ""):
-        super().__init__(name, severity=severity, message=message, for_s=for_s)
+                 message: str = "", resolve_for_s: float = 0.0):
+        super().__init__(name, severity=severity, message=message, for_s=for_s,
+                         resolve_for_s=resolve_for_s)
         if slo <= 0:
             raise ValueError(f"rule {name!r}: slo must be > 0, got {slo}")
         if not windows:
@@ -220,8 +232,10 @@ class TrendRule(Rule):
     def __init__(self, name: str, metric: str, slope_per_s: float,
                  window_s: float = 120.0, direction: str = "up",
                  min_samples: int = 4, for_s: float = 0.0,
-                 severity: str = "warning", message: str = ""):
-        super().__init__(name, severity=severity, message=message, for_s=for_s)
+                 severity: str = "warning", message: str = "",
+                 resolve_for_s: float = 0.0):
+        super().__init__(name, severity=severity, message=message, for_s=for_s,
+                         resolve_for_s=resolve_for_s)
         if direction not in ("up", "down"):
             raise ValueError(f"rule {name!r}: direction must be 'up' or 'down'")
         if slope_per_s <= 0:
@@ -257,8 +271,10 @@ class ZScoreRule(Rule):
     def __init__(self, name: str, metric: str, z: float = 4.0,
                  window_s: float = 300.0, min_samples: int = 8,
                  direction: str = "both", for_s: float = 0.0,
-                 severity: str = "warning", message: str = ""):
-        super().__init__(name, severity=severity, message=message, for_s=for_s)
+                 severity: str = "warning", message: str = "",
+                 resolve_for_s: float = 0.0):
+        super().__init__(name, severity=severity, message=message, for_s=for_s,
+                         resolve_for_s=resolve_for_s)
         if direction not in ("up", "down", "both"):
             raise ValueError(f"rule {name!r}: bad direction {direction!r}")
         self.metric = metric
@@ -496,9 +512,23 @@ class AlertEngine:
                         else:
                             st["value"] = value
                     else:  # already firing: dedup, just refresh the value
+                        # re-holding resets the resolve hysteresis clock
+                        st.pop("below_since", None)
                         st["value"] = value
                 else:
-                    if cur in ("pending", "firing"):
+                    if cur == "pending":
+                        out.append(self._transition(
+                            rule, st, "ok", now, value, rule.message))
+                    elif cur == "firing":
+                        # resolve_for_s hysteresis: the rule must stay
+                        # below threshold that long before the resolve
+                        # edge — one quiet sample must not un-page
+                        if rule.resolve_for_s > 0:
+                            below = st.setdefault("below_since", now)
+                            if (now - below) < rule.resolve_for_s:
+                                st["value"] = value
+                                continue
+                        st.pop("below_since", None)
                         out.append(self._transition(
                             rule, st, "ok", now, value, rule.message))
         return out

@@ -75,13 +75,16 @@ Provider = Callable[[], Dict]
 _ACTIVE: Optional["OpsServer"] = None
 _LOCK = threading.Lock()
 
-# GET endpoints a provider may be registered for; /submit is the one POST.
-# /fleet is the ROUTER-side aggregate feed (serve/obs.py
-# FleetObservability — the fleet router's own OpsServer registers it);
-# /alerts is the alert-engine lifecycle snapshot (telemetry/alerts.py
-# ``payload`` — frozen schema v1, served dormant too).
+# GET endpoints a provider may be registered for.  /fleet is the
+# ROUTER-side aggregate feed (serve/obs.py FleetObservability — the fleet
+# router's own OpsServer registers it); /alerts is the alert-engine
+# lifecycle snapshot (telemetry/alerts.py ``payload`` — frozen schema v1,
+# served dormant too).  POSTs: /submit enqueues a request into the serve
+# loop's inbox; /control is the rollout channel (``reload``/``status``
+# ops — serve/fleet.py registers the provider, serve/autoscale.py's
+# RolloutController is the caller).
 _GET_ENDPOINTS = ("healthz", "router", "outcomes", "fleet", "alerts")
-_POST_ENDPOINTS = ("submit",)
+_POST_ENDPOINTS = ("submit", "control")
 
 _STATUS_TEXT = {
     200: "OK",
