@@ -1704,6 +1704,16 @@ def _dispatch():
         import autoscale_smoke
 
         print(json.dumps(autoscale_smoke.run_bench()))
+    elif which == "routerha":
+        # router high availability rung (VESCALE_BENCH=routerha): the
+        # fleet journal's append cost per dispatch hop — plain router vs
+        # journaled router over the no-socket instant client, amortized
+        # against a measured request decode service time (<1% bar) —
+        # scripts/router_ha_smoke.py emits the line
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import router_ha_smoke
+
+        print(json.dumps(router_ha_smoke.run_bench()))
     elif which == "quantcomm":
         # quantized gradient collectives (VESCALE_BENCH=quantcomm): the
         # 2-proc gloo rig's grad-reduce bytes-on-the-wire + step time,

@@ -32,6 +32,8 @@ echo "=== cost-audit smoke (skewed table -> drift fires -> recalibration self-he
 python scripts/costaudit_smoke.py || failed=1
 echo "=== autoscale smoke (5x spike -> scale-up -> readmit; rolling rollout canary auto-rollback then clean commit; quiet scale-down)"
 python scripts/autoscale_smoke.py || failed=1
+echo "=== router HA smoke (kill -9 the live router mid-load -> standby takeover at bumped epoch, ledger balanced, bit-identical streams)"
+python scripts/router_ha_smoke.py || failed=1
 echo "=== what-if CLI smoke (audited (dp,tp,pp) re-scoring)"
 python -m vescale_tpu.analysis whatif --devices 8 --top 3 || failed=1
 for f in tests/test_*.py; do

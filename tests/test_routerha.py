@@ -1,0 +1,611 @@
+"""ISSUE 20 — router high availability: the durable fleet journal, crash
+recovery, and warm-standby takeover.
+
+Layers under test, bottom-up:
+
+  * framing — CRC-framed JSONL records: roundtrip, rejection of short /
+    bit-flipped / truncated lines.
+  * replay matrix — empty dir, torn tail (tolerated + counted),
+    CRC-corrupt mid-file (quarantined, neighbors survive), and the
+    snapshot+tail vs full-replay equivalence PROPERTY (the writer-side
+    reduction makes them equal by construction; this pins it).
+  * fencing — LeaderLease epochs only grow; a deposed leader's flush
+    (dual-leader write) raises FencedEpochError BEFORE bytes land, its
+    renew raises, and its late outcome can't be acked; stale-epoch
+    outcome rows fail the pump's exact-tag gate.
+  * recovery — FleetRouter.recover_from_journal rebuilds the ledger
+    (counts verbatim, pending rids WITH their per-replica tags),
+    harvests already-finished outcomes from /outcomes idempotently,
+    re-drives truly unplaced rids, and balances fleet_ledger_check.
+  * takeover — StandbyRouter promotes on lease expiry with epoch+1.
+  * satellites — faultsim router_kill / journal_torn_write contract,
+    autoscaler clock carry, rollout resume_revert in reverse order,
+    /fleet v5 `ha`, envreg knobs, and the smoke-script wiring.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.test_autoscale import _RolloutReplica
+from tests.test_fleet import FakeReplica, _req, make_router
+from vescale_tpu.analysis import envreg
+from vescale_tpu.resilience import faultsim
+from vescale_tpu.serve import obs
+from vescale_tpu.serve.autoscale import Autoscaler, RolloutController
+from vescale_tpu.serve.journal import (
+    EPOCH_SHIFT,
+    FencedEpochError,
+    FleetJournal,
+    LeaderLease,
+    empty_state,
+    frame_record,
+    make_tag,
+    parse_frame,
+    reduce_record,
+    replay_dir,
+    tag_epoch,
+)
+from vescale_tpu.serve.router import FleetRouter, StandbyRouter
+
+
+# ================================================================ framing
+def test_frame_roundtrip():
+    rec = {"k": "submit", "rid": 7, "req": {"prompt": [1, 2, 3]}}
+    line = frame_record(rec)
+    assert line.endswith(b"\n") and line[8:9] == b" "
+    assert parse_frame(line) == rec
+
+
+def test_parse_frame_rejects_defects():
+    line = frame_record({"k": "open", "e": 1})
+    assert parse_frame(b"") is None
+    assert parse_frame(b"deadbeef") is None  # too short, no payload
+    assert parse_frame(line[: len(line) // 2]) is None  # torn
+    flipped = bytearray(line)
+    flipped[-3] ^= 0x01  # payload bit flip -> crc mismatch
+    assert parse_frame(bytes(flipped)) is None
+    # crc over a DIFFERENT payload
+    assert parse_frame(b"00000000 " + line[9:]) is None
+
+
+def test_epoch_tags():
+    t = make_tag(3, 41)
+    assert tag_epoch(t) == 3 and (t & ((1 << EPOCH_SHIFT) - 1)) == 41
+    assert tag_epoch(41) == 0  # epoch 0 == bare counter (journaling off)
+
+
+# ========================================================== replay matrix
+def test_replay_empty_dir(tmp_path):
+    state, stats = replay_dir(str(tmp_path))
+    assert state == empty_state()
+    assert stats == {
+        "records": 0, "snapshots": 0, "quarantined": 0, "torn": 0, "segments": 0,
+    }
+
+
+def _mini_journal(dirpath, n=4):
+    j = FleetJournal(str(dirpath), snapshot_every=0)
+    j.begin_epoch(1)
+    for rid in range(n):
+        j.append("submit", {"rid": rid, "req": {"rid": rid, "prompt": [1],
+                                                "max_new_tokens": 2}})
+        j.append("dispatch", {"rid": rid, "replica": "a",
+                              "tag": make_tag(1, rid), "kind": "dispatch"})
+    j.close()
+    return j
+
+
+def test_journal_roundtrip_replay_equals_writer_state(tmp_path):
+    j = _mini_journal(tmp_path)
+    state, stats = replay_dir(str(tmp_path))
+    assert state == j.state  # writer-side reduction IS replay
+    assert stats["records"] == 9 and stats["quarantined"] == 0
+    assert state["counts"]["submitted"] == 4
+    assert sorted(state["pending"]) == ["0", "1", "2", "3"]
+
+
+def test_torn_tail_tolerated(tmp_path):
+    _mini_journal(tmp_path)
+    seg = os.path.join(str(tmp_path), "wal-000001.log")
+    data = open(seg, "rb").read()
+    # tear the last record mid-frame, as a dying write would
+    lines = data.rstrip(b"\n").split(b"\n")
+    torn = b"\n".join(lines[:-1]) + b"\n" + lines[-1][: len(lines[-1]) // 2]
+    open(seg, "wb").write(torn)
+    state, stats = replay_dir(str(tmp_path))
+    assert stats["torn"] == 1 and stats["quarantined"] == 0
+    # the torn record was rid 3's dispatch: it is pending with no tag
+    assert state["pending"]["3"]["tags"] == {}
+    assert state["counts"]["submitted"] == 4
+
+
+def test_crc_corrupt_midfile_quarantined_neighbors_survive(tmp_path):
+    _mini_journal(tmp_path)
+    seg = os.path.join(str(tmp_path), "wal-000001.log")
+    lines = open(seg, "rb").read().rstrip(b"\n").split(b"\n")
+    bad = bytearray(lines[2])  # rid 0's dispatch record — mid-file
+    bad[-2] ^= 0x40
+    lines[2] = bytes(bad)
+    open(seg, "wb").write(b"\n".join(lines) + b"\n")
+    state, stats = replay_dir(str(tmp_path))
+    assert stats["quarantined"] == 1 and stats["torn"] == 0
+    assert stats["records"] == 8  # every OTHER record survived
+    assert state["counts"]["submitted"] == 4
+    assert state["pending"]["0"]["tags"] == {}  # exactly ONE record lost
+    assert state["pending"]["1"]["tags"] == {"a": make_tag(1, 1)}
+
+
+def test_snapshot_plus_tail_equals_full_replay_property(tmp_path):
+    """The equivalence PROPERTY: the same logical record sequence through
+    a snapshotting+rotating journal and through a never-snapshotting one
+    replays to the same reduced state."""
+    import random
+
+    rng = random.Random(20)
+    ops = []
+    alive = []
+    for rid in range(40):
+        ops.append(("submit", {"rid": rid, "req": {"rid": rid, "prompt": [1],
+                                                   "max_new_tokens": 2}}))
+        alive.append(rid)
+        ops.append(("dispatch", {
+            "rid": rid, "replica": rng.choice(["a", "b"]),
+            "tag": make_tag(1, rid),
+            "kind": rng.choice(["dispatch", "failover", "hedge"]),
+        }))
+        if rng.random() < 0.6 and alive:
+            done = alive.pop(rng.randrange(len(alive)))
+            ops.append(("terminal", {
+                "rid": done, "replica": "a",
+                "status": rng.choice(["completed", "shed", "timed_out"]),
+                "outcome": {"status": "completed", "tokens": [5, 5]},
+            }))
+    da, db = tmp_path / "snap", tmp_path / "flat"
+    ja = FleetJournal(str(da), snapshot_every=7, rotate_bytes=512)
+    jb = FleetJournal(str(db), snapshot_every=0)
+    for j in (ja, jb):
+        j.begin_epoch(1)
+    for kind, data in ops:
+        for j in (ja, jb):
+            j.append(kind, dict(data))
+        if ja.should_snapshot():
+            ja.write_snapshot({"ring": ["a", "b"]})
+    ja.close(), jb.close()
+    sa, stats_a = replay_dir(str(da))
+    sb, _ = replay_dir(str(db))
+    assert stats_a["snapshots"] >= 2
+    assert len(os.listdir(da)) <= 2  # rotation pruned dead segments
+    sa.pop("extras"), sb.pop("extras")  # snapshot-only, by design
+    assert sa == sb
+
+
+# ================================================================ fencing
+def test_lease_acquire_renew_and_takeover_fences():
+    t = [0.0]
+    now = lambda: t[0]
+    import tempfile
+
+    path = os.path.join(tempfile.mkdtemp(), "LEASE")
+    leader = LeaderLease(path, "leader", ttl_s=2.0, now_fn=now)
+    assert leader.acquire() == 1
+    t[0] += 1.0
+    leader.renew()  # live: extends
+    standby = LeaderLease(path, "standby", ttl_s=2.0, now_fn=now)
+    with pytest.raises(FencedEpochError):
+        standby.acquire()  # live foreign lease
+    t[0] += 10.0  # leader dies silently; lease expires
+    assert standby.acquire() == 2  # epoch bumps on takeover
+    t[0] += 1.0
+    with pytest.raises(FencedEpochError):
+        leader.renew()  # deposed
+
+
+def test_dual_leader_journal_write_refused(tmp_path):
+    t = [0.0]
+    now = lambda: t[0]
+    path = os.path.join(str(tmp_path), "LEASE")
+    leader = LeaderLease(path, "leader", ttl_s=1.0, now_fn=now)
+    j = FleetJournal(str(tmp_path / "wal"), lease=leader)
+    j.begin_epoch(leader.acquire())
+    j.append("submit", {"rid": 0, "req": {}})
+    j.flush()  # live: lands
+    t[0] += 5.0
+    LeaderLease(path, "standby", ttl_s=1.0, now_fn=now).acquire()
+    j.append("submit", {"rid": 1, "req": {}})
+    with pytest.raises(FencedEpochError):
+        j.flush()  # deposed: refused BEFORE bytes land
+    state, _ = replay_dir(str(tmp_path / "wal"))
+    assert state["counts"]["submitted"] == 1  # rid 1 never made it to disk
+
+
+def test_deposed_leader_cannot_ack_outcome(tmp_path):
+    """The _resolve barrier: the old leader's terminal flush raises, so
+    the rid it would have acked stays pending in ITS ledger — only the
+    new leader (which owns the journal now) can resolve it."""
+    t = [0.0]
+    now = lambda: t[0]
+    lease = LeaderLease(os.path.join(str(tmp_path), "LEASE"), "leader",
+                        ttl_s=1.0, now_fn=now)
+    j = FleetJournal(str(tmp_path / "wal"))
+    a = FakeReplica("a")
+    fr, _clock = make_router([a], journal=j, lease=lease)
+    fr.submit(_req(0))
+    rec = fr.ledger.records[0]
+    a.finish(0, tag=rec.tag_by_replica["a"])
+    t[0] += 5.0  # lease expires; a standby takes over
+    LeaderLease(os.path.join(str(tmp_path), "LEASE"), "standby",
+                ttl_s=1.0, now_fn=now).acquire()
+    with pytest.raises(FencedEpochError):
+        fr.pump()  # the harvest's ack hits the fence
+    assert fr.ledger.records[0].pending  # never double-resolved
+
+
+def test_stale_epoch_outcome_rejected_by_tag_gate():
+    a = FakeReplica("a")
+    fr, _t = make_router([a])
+    fr.epoch = 2  # as if recovered under epoch 2
+    fr.submit(_req(0))
+    rec = fr.ledger.records[0]
+    tag = rec.tag_by_replica["a"]
+    assert tag_epoch(tag) == 2
+    # a deposed epoch-1 leader's placement echoes its own stale tag
+    a.finish(0, tag=make_tag(1, tag & ((1 << EPOCH_SHIFT) - 1)))
+    fr.pump()
+    assert rec.pending  # stale row visible but never consumed
+    a.finish(0, tag=tag)
+    fr.pump()
+    assert rec.status == "completed"
+
+
+# =============================================================== recovery
+def _recover_kwargs(t):
+    return dict(
+        poll_interval_s=0.0, breaker_failures=2, breaker_cooldown_s=1.0,
+        health_stale_s=0.0, dispatch_retries=3, backoff_s=0.01,
+        backoff_max_s=0.1, hedge_s=0.0,
+        now_fn=lambda: t[0], sleep_fn=lambda s: t.__setitem__(0, t[0] + s),
+    )
+
+
+def test_crash_recovery_end_to_end(tmp_path):
+    j = FleetJournal(str(tmp_path))
+    a, b = FakeReplica("a"), FakeReplica("b")
+    fr, _t = make_router([a, b], journal=j)
+    assert fr.epoch == 1
+    for rid in range(6):
+        fr.submit(_req(rid), session=f"s{rid % 2}")
+    for rep in (a, b):
+        for rid_s in list(rep.inflight):
+            if int(rid_s) < 3:
+                rep.finish(int(rid_s), tag=rep.inflight[rid_s]["tag"])
+    fr.pump()
+    assert fr.ledger.pending_count() == 3
+    # ---- crash: fr is abandoned; a new process recovers from the dir
+    a2, b2 = FakeReplica("a"), FakeReplica("b")
+    a2.inflight, a2.done = a.inflight, a.done  # replicas kept running
+    b2.inflight, b2.done = b.inflight, b.done
+    t2 = [100.0]
+    fr2 = FleetRouter.recover_from_journal(
+        FleetJournal(str(tmp_path)), {"a": a2, "b": b2}, **_recover_kwargs(t2)
+    )
+    assert fr2.epoch == 2  # leaseless restart still bumps the generation
+    assert fr2.recovery["pending_at_recovery"] == 3
+    assert fr2.recovery["quarantined"] == 0
+    assert fr2.ledger.counts["submitted"] == 6
+    assert fr2.ledger.counts["completed"] == 3
+    # the reconstructed pending rids still carry their OLD dispatch tags
+    for rec in fr2.ledger.pending():
+        assert rec.live_on and all(
+            tag_epoch(tg) == 1 for tg in rec.tag_by_replica.values()
+        )
+    for rep in (a2, b2):
+        for rid_s in list(rep.inflight):
+            rep.finish(int(rid_s), tag=rep.inflight[rid_s]["tag"])
+    fr2.pump()
+    fr2.fleet_ledger_check()  # balanced: zero lost, zero duplicated
+    assert fr2.ledger.counts["completed"] == 6
+
+
+def test_recovery_harvests_finished_outcomes(tmp_path):
+    """Rids that FINISHED while the router was dead are harvested from
+    the /outcomes linger during recovery itself — no re-drive."""
+    j = FleetJournal(str(tmp_path))
+    a = FakeReplica("a")
+    fr, _t = make_router([a], journal=j)
+    fr.submit(_req(0)), fr.submit(_req(1))
+    # both finish AFTER the crash, before recovery polls
+    for rid_s in list(a.inflight):
+        a.finish(int(rid_s), tag=a.inflight[rid_s]["tag"])
+    t2 = [50.0]
+    fr2 = FleetRouter.recover_from_journal(
+        FleetJournal(str(tmp_path)), {"a": a}, **_recover_kwargs(t2)
+    )
+    assert fr2.recovery["harvested"] == 2
+    assert fr2.recovery["redriven"] == 0
+    fr2.fleet_ledger_check()
+
+
+def test_recovery_redrives_unplaced_rid_from_prompt(tmp_path):
+    """A rid whose only placement died with the fleet is re-driven from
+    the journaled prompt (bit-identical by decode determinism)."""
+    j = FleetJournal(str(tmp_path))
+    j.begin_epoch(1)
+    j.append("submit", {"rid": 9, "req": {"rid": 9, "prompt": [1, 2],
+                                          "max_new_tokens": 2}})
+    j.append("dispatch", {"rid": 9, "replica": "dead",
+                          "tag": make_tag(1, 1), "kind": "dispatch"})
+    j.close()
+    a = FakeReplica("a")
+    t2 = [0.0]
+    fr2 = FleetRouter.recover_from_journal(
+        FleetJournal(str(tmp_path)), {"a": a}, **_recover_kwargs(t2)
+    )
+    assert fr2.recovery["redriven"] == 1
+    rec = fr2.ledger.records[9]
+    assert rec.live_on == ["a"] and tuple(rec.req.prompt) == (1, 2)
+    assert fr2.ledger.counts["failovers"] == 1
+    a.finish(9, tag=rec.tag_by_replica["a"])
+    fr2.pump()
+    fr2.fleet_ledger_check()
+
+
+def test_harvest_is_idempotent_across_leaders(tmp_path):
+    """Satellite 3 regression: a terminal row the DEAD leader already
+    journaled (acked) still lingers in /outcomes — the recovered leader
+    must not resolve it a second time."""
+    j = FleetJournal(str(tmp_path))
+    a = FakeReplica("a")
+    fr, _t = make_router([a], journal=j)
+    fr.submit(_req(0))
+    a.finish(0, tag=fr.ledger.records[0].tag_by_replica["a"])
+    fr.pump()  # old leader journals + acks the terminal...
+    assert fr.ledger.counts["completed"] == 1
+    assert "0" in a.done  # ...and the row still lingers replica-side
+    t2 = [50.0]
+    fr2 = FleetRouter.recover_from_journal(
+        FleetJournal(str(tmp_path)), {"a": a}, **_recover_kwargs(t2)
+    )
+    assert fr2.ledger.counts["completed"] == 1  # exactly once
+    assert fr2.ledger.counts["submitted"] == 1
+    assert fr2.recovery["harvested"] == 0
+    fr2.fleet_ledger_check()
+    # the recovered history still carries the tokens (bit-identity audit)
+    assert fr2.ledger.records[0].outcome["tokens"] == [5, 5]
+
+
+def test_recovery_restores_breakers_and_extras(tmp_path):
+    j = FleetJournal(str(tmp_path))
+    a, b = FakeReplica("a"), FakeReplica("b")
+    fr, _t = make_router([a, b], journal=j)
+    fr.submit(_req(0))
+    fr.autoscale_journal_provider = lambda: {"scale_ups": 3}
+    fr.rollout_state = {"checkpoint": "ck", "committed": ["a"],
+                        "in_progress": "b"}
+    h = fr.replicas["b"]
+    h.breaker.state = type(h.breaker).OPEN
+    j.write_snapshot(fr._journal_extras())
+    t2 = [50.0]
+    fr2 = FleetRouter.recover_from_journal(
+        FleetJournal(str(tmp_path)), {"a": a, "b": b},
+        harvest=False, **_recover_kwargs(t2)
+    )
+    assert fr2.replicas["b"].breaker.state == type(h.breaker).OPEN
+    assert fr2.replicas["a"].breaker.state == type(h.breaker).CLOSED
+    assert fr2.recovered_autoscale_state == {"scale_ups": 3}
+    assert fr2.rollout_state["in_progress"] == "b"
+    assert set(fr2.ring.nodes()) == {"a", "b"}
+
+
+# =============================================================== takeover
+def test_standby_takeover_on_lease_expiry(tmp_path):
+    t = [0.0]
+    now = lambda: t[0]
+    lease_path = os.path.join(str(tmp_path), "LEASE")
+    leader_lease = LeaderLease(lease_path, "leader", ttl_s=2.0, now_fn=now)
+    a = FakeReplica("a")
+    fr, _clock = make_router([a], journal=FleetJournal(str(tmp_path)),
+                             lease=leader_lease)
+    fr.submit(_req(0))
+    standby = StandbyRouter(
+        str(tmp_path), {"a": a},
+        lease=LeaderLease(lease_path, "standby", ttl_s=2.0, now_fn=now),
+        router_kwargs=_recover_kwargs([100.0]),
+    )
+    assert standby.poll() is None  # leader alive
+    tail = standby.tail()
+    assert tail["pending"] == 1 and tail["epoch"] == 1
+    t[0] += 10.0  # leader dies silently; lease runs out
+    fr2 = standby.poll()
+    assert fr2 is not None and fr2.epoch == 2
+    assert fr2.recovery["takeover"] is True
+    assert standby.poll() is fr2  # idempotent
+    # the deposed leader can no longer write
+    fr.journal.append("submit", {"rid": 99, "req": {}})
+    with pytest.raises(FencedEpochError):
+        fr.journal.flush()
+    # the new leader finishes the battery
+    a.finish(0, tag=fr2.ledger.records[0].tag_by_replica["a"])
+    fr2.pump()
+    fr2.fleet_ledger_check()
+
+
+# ============================================================== satellites
+def test_ha_fault_kinds_parse_and_fire():
+    faults = faultsim.parse_schedule(
+        "router_kill:call=2;journal_torn_write:step=3,count=4"
+    )
+    assert [f.kind for f in faults] == ["router_kill", "journal_torn_write"]
+    inj = faultsim.arm(faults)
+    try:
+        assert not inj.fires("router_kill")  # call 0
+        assert not inj.fires("router_kill")  # call 1
+        assert inj.fires("router_kill")  # call 2
+        assert not inj.fires("router_kill")  # count=1 exhausted
+        inj.set_step(3)
+        fired = sum(1 for _ in range(10) if inj.fires("journal_torn_write"))
+        assert fired == 4
+        inj.set_step(8)
+        assert not inj.fires("journal_torn_write")
+    finally:
+        faultsim.disarm()
+
+
+def test_ha_fault_kinds_disarmed_hooks_are_noop_refs():
+    assert faultsim.fires is faultsim._noop_fires
+    assert faultsim.fires("router_kill") is False
+    assert faultsim.fires("journal_torn_write") is False
+    assert "router_kill" in faultsim.KINDS
+    assert "journal_torn_write" in faultsim.KINDS
+
+
+def test_journal_torn_write_fault_produces_recoverable_torn_tail(tmp_path):
+    faultsim.arm(faultsim.parse_schedule("journal_torn_write:call=0"))
+    try:
+        j = FleetJournal(str(tmp_path))
+        j.begin_epoch(1)  # this flush is torn by the fault
+        j.close()
+    finally:
+        faultsim.disarm()
+    state, stats = replay_dir(str(tmp_path))
+    assert stats["torn"] == 1 and stats["records"] == 0
+    # a fresh journal opens over the torn tail and keeps going
+    j2 = FleetJournal(str(tmp_path))
+    j2.begin_epoch(2)
+    j2.append("submit", {"rid": 0, "req": {}})
+    j2.close()
+    state, stats = replay_dir(str(tmp_path))
+    # the torn line merged with the next write and quarantined: ONE
+    # record lost, counted, everything after it replays
+    assert stats["quarantined"] == 1
+    assert state["counts"]["submitted"] == 1
+
+
+def test_autoscaler_clocks_carry_across_recovery():
+    """Satellite 2: hold/cooldown clocks survive as AGES and re-anchor
+    onto the recovered router's clock — no flapped decisions."""
+    a = FakeReplica("a")
+    fr, t = make_router([a])
+    asc = Autoscaler(fr, None, "a", client_factory=lambda spec: None,
+                     min_replicas=1, max_replicas=4,
+                     cooldown_s=10.0, now_fn=lambda: t[0])
+    t[0] = 100.0
+    asc._over_since = 97.0  # held 3s
+    asc._last_action_at = 94.0  # 6s into a 10s cooldown
+    asc._draining = {"a": 99.0}
+    asc.scale_ups = 2
+    snap = asc.snapshot_state()
+    assert snap["over_for_s"] == pytest.approx(3.0)
+    assert snap["since_action_s"] == pytest.approx(6.0)
+    # a recovered router on a DIFFERENT clock origin
+    a2 = FakeReplica("a")
+    fr2, t2 = make_router([a2])
+    t2[0] = 5000.0
+    fr2.recovered_autoscale_state = snap
+    asc2 = Autoscaler(fr2, None, "a", client_factory=lambda spec: None,
+                      min_replicas=1, max_replicas=4,
+                      cooldown_s=10.0, now_fn=lambda: t2[0])
+    assert fr2.recovered_autoscale_state is None  # consumed
+    assert asc2._over_since == pytest.approx(4997.0)  # still held 3s
+    assert asc2._last_action_at == pytest.approx(4994.0)
+    assert asc2._draining["a"] == pytest.approx(4999.0)
+    assert asc2.scale_ups == 2
+    # cooldown still live: 6s elapsed of 10 -> no action for 4 more
+    assert asc2.state()["cooldown_remaining_s"] == pytest.approx(4.0)
+
+
+def test_rollout_resume_revert_reverse_order():
+    """Satellite 2: a rollout interrupted by a router crash is revertible
+    after recovery — in-progress replica first, then committed ones in
+    REVERSE commit order."""
+    reps = [_RolloutReplica(r, [[5, 6]]) for r in ("r1", "r2", "r3")]
+    a_map = {r.id: r for r in reps}
+    t = [0.0]
+    fr = FleetRouter(
+        poll_interval_s=0.0, breaker_failures=99, breaker_cooldown_s=1.0,
+        health_stale_s=0.0, dispatch_retries=1, backoff_s=0.01,
+        backoff_max_s=0.1, hedge_s=0.0,
+        now_fn=lambda: t[0], sleep_fn=lambda s: t.__setitem__(0, t[0] + s),
+    )
+    for r in reps:
+        fr.add_replica(r.id, r)
+    # as recovered from the journal snapshot: r1, r2 committed; r3 mid-swap
+    fr.rollout_state = {"checkpoint": "ck-9", "committed": ["r1", "r2"],
+                        "in_progress": "r3"}
+    res = RolloutController.resume_revert(
+        fr, now_fn=lambda: t[0],
+        sleep_fn=lambda s: t.__setitem__(0, t[0] + s),
+    )
+    assert res["ok"] is False
+    assert res["rolled_back"] == ["r3", "r2", "r1"]  # reverse order
+    assert fr.rollout_state is None
+    for r in reps:
+        assert [op["op"] for op in r.ops if op["op"] == "revert"] == ["revert"]
+        assert r.state["state"] == "rolled_back"
+    # no rollout in flight -> no-op
+    assert RolloutController.resume_revert(fr) is None
+
+
+def test_fleet_feed_v5_carries_ha(tmp_path):
+    assert obs.FLEET_SCHEMA_VERSION == 5
+    assert obs.FLEET_FIELDS - obs.FLEET_FIELDS_V4 == {"ha"}
+    a = FakeReplica("a")
+    plain, _ = make_router([a])
+    assert plain.obs.fleet()["ha"] is None  # journaling off
+    b = FakeReplica("b")
+    fr, _t = make_router([b], journal=FleetJournal(str(tmp_path)))
+    feed = fr.obs.fleet()
+    assert feed["schema_version"] == 5
+    assert feed["ha"]["role"] == "leader" and feed["ha"]["epoch"] == 1
+    assert feed["ha"]["journal"]["dir"] == str(tmp_path)
+
+
+def test_journal_off_is_byte_identical_pre_ha():
+    a = FakeReplica("a")
+    fr, _t = make_router([a])
+    assert fr.journal is None and fr.lease is None and fr.epoch == 0
+    fr.submit(_req(0))
+    # epoch 0: tags are bare counters, exactly the pre-HA wire
+    assert fr.ledger.records[0].tag_by_replica["a"] == 1
+
+
+def test_ha_envreg_knobs_registered():
+    for name, default in [
+        ("VESCALE_FLEET_JOURNAL_DIR", None),
+        ("VESCALE_FLEET_JOURNAL_FSYNC", "flush"),
+        ("VESCALE_FLEET_JOURNAL_ROTATE_BYTES", 1048576),
+        ("VESCALE_FLEET_JOURNAL_SNAPSHOT_EVERY", 256),
+        ("VESCALE_FLEET_LEASE_PATH", None),
+        ("VESCALE_FLEET_LEASE_TTL_S", 2.0),
+    ]:
+        assert envreg.lookup(name).default == default
+
+
+def test_journal_fsync_policy_validated(tmp_path):
+    with pytest.raises(ValueError):
+        FleetJournal(str(tmp_path), fsync="sometimes")
+    for pol in ("none", "flush", "always"):
+        j = FleetJournal(str(tmp_path / pol), fsync=pol)
+        j.begin_epoch(1)
+        j.close()
+        state, _ = replay_dir(str(tmp_path / pol))
+        assert state["epoch"] == 1
+
+
+def test_router_ha_smoke_script():
+    """The acceptance battery: kill -9 on the live router mid-load ->
+    the standby finishes with a balanced ledger and bit-identical
+    streams (scripts/router_ha_smoke.py, wired into run_test.sh)."""
+    script = os.path.join(
+        os.path.dirname(__file__), "..", "scripts", "router_ha_smoke.py"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True,
+        timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + "\n" + proc.stderr
+    assert "ROUTER HA SMOKE OK" in proc.stdout

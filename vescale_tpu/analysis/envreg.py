@@ -344,6 +344,20 @@ register("VESCALE_FLEET_TRACE_FLUSH_EVERY", "int", 1,
          "Boundary cadence at which a fleet-traced replica flushes its span ring to the trace stream (1 = every boundary; higher trades crash-durability of the newest spans for fewer writes).")
 register("VESCALE_FLEET_OPS_PORT", "int", None,
          "Localhost port for the fleet ROUTER's own ops endpoints (`/fleet` aggregate rollup, `/healthz`, router-process `/metrics`): unset = off (no socket, no thread), 0 = auto-assign (docs/serving.md).")
+# --- router high availability (serve/journal.py) ---------------------
+register("VESCALE_FLEET_JOURNAL_DIR", "str", None,
+         "Directory for the fleet router's write-ahead journal (CRC-framed JSONL of every ledger transition + compacted snapshots): a FleetRouter constructed without an explicit journal opens one here, enabling crash recovery and warm-standby takeover; unset = journaling off, pre-HA behavior byte-identical (docs/serving.md router HA).")
+register("VESCALE_FLEET_JOURNAL_FSYNC", "str", "flush",
+         "Journal durability policy: `none` (OS page cache only), `flush` (fsync at flush boundaries — poll/snapshot/terminal-ack, the default), `always` (fsync every write; the paranoid setting the <1% overhead bar is measured against).")
+register("VESCALE_FLEET_JOURNAL_ROTATE_BYTES", "int", 1048576,
+         "Journal segment size in bytes past which the next snapshot rotates to a fresh `wal-NNNNNN.log` segment (older segments beyond the last two are pruned — the snapshot makes them dead weight).")
+register("VESCALE_FLEET_JOURNAL_SNAPSHOT_EVERY", "int", 256,
+         "Appended records between compacted journal snapshots (each folds ledger counts, pending rids, affinity ring, breaker states, autoscaler clocks and rollout stage into ONE record so recovery replays snapshot+tail, not history).")
+register("VESCALE_FLEET_LEASE_PATH", "str", None,
+         "Path of the fenced leader-lease file ({epoch, holder, expires_at}, written atomically): a FleetRouter constructed without an explicit lease acquires one here, stamping its epoch into every dispatch tag so a deposed leader's stale placements can never double-resolve a rid; unset = no fencing (single-router deployments).")
+register("VESCALE_FLEET_LEASE_TTL_S", "float", 2.0,
+         "Leader-lease time-to-live in seconds: the leader renews at TTL/3 on its poll cadence, and a warm standby whose poll finds the lease expired takes over by acquiring epoch+1 (docs/serving.md router HA).")
+
 register("VESCALE_SERVE_TENANT_WEIGHTS", "str", None,
          "Per-tenant SLO-class weights as `tenant:weight[,tenant:weight...]` (e.g. `gold:3,free:1`): each tenant's share of the admission queue is capped at max_queue x weight/total (unlisted tenants weigh 1.0), so an overloaded tenant sheds before it can starve the others; unset disables tenant-weighted shedding entirely (docs/serving.md).")
 

@@ -65,6 +65,13 @@ FLEET_TERMINAL = "fleet-terminal"
 # the requests it affected.
 FLEET_SCALE = "fleet-scale"
 FLEET_ROLLOUT = "fleet-rollout-stage"
+# router high availability (serve/journal.py + FleetRouter.recover_from_
+# journal / StandbyRouter; fleettrace.recover_event / takeover_event
+# emit).  One span per crash recovery (journal replay -> /outcomes
+# harvest -> re-drive) and per warm-standby promotion, so the leaderless
+# window and the reconstruction cost read inline on the fleet timeline.
+FLEET_RECOVER = "fleet-recover"
+FLEET_TAKEOVER = "fleet-takeover"
 # alert-engine lifecycle (telemetry/alerts.py emits): a point span per
 # transition plus, on resolve, one span covering the whole firing episode
 # — so a Perfetto timeline shows the alert as a bar spanning exactly the

@@ -75,6 +75,18 @@ Fault kinds and their hook sites:
                     freshly swapped weights, so the canary's token-stream
                     comparison diverges and the auto-rollback path is
                     provable without a genuinely bad checkpoint
+  router_kill       observed by ``FleetRouter.pump`` — the ROUTER process
+                    dies abruptly at the Nth pump boundary (``os._exit``
+                    with ``VESCALE_FAULTSIM_KILL_EXIT_CODE``, default
+                    29): no drain, no lease release — the crashed-leader
+                    substrate the journal recovery and warm-standby
+                    takeover paths are proven against
+                    (scripts/router_ha_smoke.py)
+  journal_torn_write  observed by ``FleetJournal.flush`` — the LAST
+                    buffered record of the Nth flush is written torn
+                    (truncated mid-frame, as if the process died inside
+                    ``write``), exercising the replayer's torn-tail
+                    tolerance without killing anything
   ================  ====================================================
 
 Gating contract (the ``telemetry.init()`` pattern): while disarmed the
@@ -121,6 +133,8 @@ KINDS = (
     "replica_kill",
     "poll_blackhole",
     "canary_diverge",
+    "router_kill",
+    "journal_torn_write",
 )
 
 # errors raised by `check` per kind; observation-level kinds (nonfinite_loss,

@@ -19,10 +19,11 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from . import autoscale, fleet, fleettrace, obs, prefix_cache, reqtrace, router, speculative
+from . import autoscale, fleet, fleettrace, journal, obs, prefix_cache, reqtrace, router, speculative
 from .autoscale import Autoscaler, RolloutController
 from .engine import ServeEngine
 from .fleet import FleetSupervisor, ReplicaSpec, RequestInbox, serve_replica
+from .journal import FencedEpochError, FleetJournal, LeaderLease
 from .fleettrace import (
     FleetClockSync,
     assemble_fleet_timeline,
@@ -41,6 +42,7 @@ from .router import (
     FleetLedger,
     FleetRouter,
     HttpReplicaClient,
+    StandbyRouter,
 )
 from .scheduler import ContinuousBatchingScheduler, Request, ShedError
 
@@ -71,6 +73,11 @@ __all__ = [
     "FleetLedger",
     "FleetRouter",
     "HttpReplicaClient",
+    "StandbyRouter",
+    "FleetJournal",
+    "LeaderLease",
+    "FencedEpochError",
+    "journal",
     "RequestInbox",
     "ReplicaSpec",
     "FleetSupervisor",

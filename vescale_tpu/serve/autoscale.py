@@ -139,6 +139,13 @@ class Autoscaler:
         self.scale_downs = 0
         # /fleet v4 carries the controller's view once one is attached
         router.obs.autoscale_provider = self.state
+        # the router's journal snapshots carry the control clocks (HA);
+        # a router recovered from a journal hands them straight back
+        router.autoscale_journal_provider = self.snapshot_state
+        recovered = getattr(router, "recovered_autoscale_state", None)
+        if recovered:
+            self.restore_state(recovered)
+            router.recovered_autoscale_state = None
 
     # ------------------------------------------------------------ signals
     def _signals(self) -> Dict[str, Optional[float]]:
@@ -314,6 +321,48 @@ class Autoscaler:
             ),
         }
 
+    # --------------------------------------------------- journal carry
+    def snapshot_state(self) -> Dict[str, Any]:
+        """The hold/cooldown clocks as AGES (clock-independent), folded
+        into the router's journal snapshots so a recovered router neither
+        flaps a half-held scale decision nor forgets a live cooldown."""
+        now = self._now()
+
+        def _age(t: Optional[float]) -> Optional[float]:
+            return None if t is None else max(0.0, now - t)
+
+        return {
+            "over_for_s": _age(self._over_since),
+            "under_for_s": _age(self._under_since),
+            "since_action_s": _age(self._last_action_at),
+            "draining_for_s": {r: _age(t) for r, t in self._draining.items()},
+            "last_decision": self.last_decision,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+        }
+
+    def restore_state(self, snap: Dict[str, Any],
+                      now: Optional[float] = None) -> None:
+        """Back-convert a :meth:`snapshot_state` dict onto THIS
+        controller's clock (the inverse of the age encoding)."""
+        if not snap:
+            return
+        if now is None:
+            now = self._now()
+
+        def _at(age) -> Optional[float]:
+            return None if age is None else now - float(age)
+
+        self._over_since = _at(snap.get("over_for_s"))
+        self._under_since = _at(snap.get("under_for_s"))
+        self._last_action_at = _at(snap.get("since_action_s"))
+        self._draining = {
+            r: _at(a) for r, a in (snap.get("draining_for_s") or {}).items()
+        }
+        self.last_decision = snap.get("last_decision", self.last_decision)
+        self.scale_ups = int(snap.get("scale_ups") or 0)
+        self.scale_downs = int(snap.get("scale_downs") or 0)
+
 
 def _fmt(v: Optional[float]) -> str:
     return "na" if v is None else f"{float(v):.3g}"
@@ -414,6 +463,14 @@ class RolloutController:
         )
         committed: List[str] = []
         for rid in order:
+            # mirrored into the journal snapshots (router HA): a router
+            # crash mid-rollout recovers this and can resume_revert —
+            # reverse-order, exactly what _rollback would have done
+            self.router.rollout_state = {
+                "checkpoint": self.checkpoint,
+                "committed": list(committed),
+                "in_progress": rid,
+            }
             t0 = time.perf_counter()
             res = self._post_and_wait(
                 rid,
@@ -450,6 +507,7 @@ class RolloutController:
             self._post_and_wait(rid, {"op": "commit"}, terminal=("committed",))
         _tel.record_event("fleet_rollout_committed", checkpoint=self.checkpoint,
                           replicas=len(committed))
+        self.router.rollout_state = None
         return {
             "ok": True,
             "committed": committed,
@@ -481,11 +539,59 @@ class RolloutController:
         _tel.record_event("fleet_rollout_rolled_back",
                           checkpoint=self.checkpoint, reason=why,
                           replicas=len(rolled))
+        self.router.rollout_state = None
         return {
             "ok": False,
             "committed": [],
             "rolled_back": rolled,
             "diverged": diverged,
+            "reason": why,
+            "streams": None,
+        }
+
+    @classmethod
+    def resume_revert(cls, router, **kw) -> Optional[Dict[str, Any]]:
+        """Finish an interrupted rollout after crash recovery: the
+        journal snapshot carried ``router.rollout_state`` — the replicas
+        already committed and the one that was mid-swap when the leader
+        died.  The only safe completion without the original canary
+        context is the rollback leg: revert the in-progress replica and
+        then every committed one in REVERSE order (the same walk
+        ``_rollback`` does).  Returns that rollback result, or None when
+        no rollout was in flight."""
+        from .. import telemetry as _tel
+
+        st = getattr(router, "rollout_state", None)
+        if not st:
+            return None
+        ctl = cls(router, st["checkpoint"], prompts=[], canary=False, **kw)
+        why = "rollout interrupted by router crash"
+        # unlike _rollback's diverged replica (which reverted itself),
+        # the mid-swap replica got no verdict — revert it too, first
+        order = [r for r in st.get("committed") or [] if r in router.replicas]
+        in_progress = st.get("in_progress")
+        if in_progress in router.replicas and in_progress not in order:
+            order.append(in_progress)
+        _tel.count("fleet_rollbacks_total")
+        rolled: List[str] = []
+        for rid in reversed(order):
+            t0 = time.perf_counter()
+            res = ctl._post_and_wait(rid, {"op": "revert"},
+                                     terminal=("rolled_back",))
+            fleettrace.rollout_stage(rid, "fleet-revert",
+                                     time.perf_counter() - t0,
+                                     ok=res["ok"], reason=why,
+                                     checkpoint=ctl.checkpoint)
+            rolled.append(rid)
+        _tel.record_event("fleet_rollout_rolled_back",
+                          checkpoint=ctl.checkpoint, reason=why,
+                          replicas=len(rolled))
+        router.rollout_state = None
+        return {
+            "ok": False,
+            "committed": [],
+            "rolled_back": rolled,
+            "diverged": in_progress,
             "reason": why,
             "streams": None,
         }

@@ -102,7 +102,10 @@ def _outcomes_snapshot(scheduler: ContinuousBatchingScheduler) -> Dict[str, Any]
                 "reason": rec.get("reason"),
                 # the dispatch-attempt token the request carried: the
                 # router uses it to reject rows from a PRIOR dispatch of
-                # the same rid to this replica
+                # the same rid to this replica.  Since router HA the tag
+                # also carries the leader epoch in its high bits
+                # (serve/journal.py make_tag), so the same exact-match
+                # gate makes post-crash harvest idempotent across leaders
                 "tag": rec.get("tag"),
             }
     return rows

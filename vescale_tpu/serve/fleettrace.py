@@ -76,6 +76,8 @@ __all__ = [
     "fleet_terminal",
     "scale_event",
     "rollout_stage",
+    "recover_event",
+    "takeover_event",
     "FleetClockSync",
     "estimate_fleet_clock_offsets",
     "assemble_fleet_timeline",
@@ -210,6 +212,32 @@ def rollout_stage(replica: str, stage: str, dur_s: float, ok: bool = True,
     if checkpoint is not None:
         tags["checkpoint"] = checkpoint
     _record(_p.FLEET_ROLLOUT, now - dur_s, dur_s, tags)
+
+
+def recover_event(dur_s: float, *, epoch: int, records: int,
+                  quarantined: int, pending: int, harvested: int,
+                  redriven: int) -> None:
+    """One crash recovery (journal replay -> harvest -> re-drive) as a
+    span in the router's stream — the whole reconstruction reads inline
+    on the merged timeline, sized by how long the fleet ran leaderless."""
+    if not is_active():
+        return
+    now = time.time()
+    _record(_p.FLEET_RECOVER, now - dur_s, dur_s, {
+        "epoch": epoch, "records": records, "quarantined": quarantined,
+        "pending": pending, "harvested": harvested, "redriven": redriven,
+    })
+
+
+def takeover_event(dur_s: float, *, epoch: int, reason: str) -> None:
+    """A warm-standby promotion: the lease expired and the standby's
+    tail became the fleet's ledger.  ``epoch`` is the NEW fenced epoch —
+    every dispatch tag after this span carries it."""
+    if not is_active():
+        return
+    now = time.time()
+    _record(_p.FLEET_TAKEOVER, now - dur_s, dur_s,
+            {"epoch": epoch, "reason": reason})
 
 
 # ------------------------------------------------------- HTTP clock sync

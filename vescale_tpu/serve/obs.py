@@ -43,6 +43,7 @@ __all__ = [
     "FLEET_FIELDS",
     "FLEET_FIELDS_V2",
     "FLEET_FIELDS_V3",
+    "FLEET_FIELDS_V4",
     "FLEET_REPLICA_FIELDS",
     "FLEET_REPLICA_FIELDS_V1",
     "FLEET_REPLICA_FIELDS_V2",
@@ -111,7 +112,7 @@ ROUTER_FIELDS = ROUTER_FIELDS_V4 | frozenset(("tenants", "rollout"))
 # tests): the live view an operator — or ROADMAP item 2's auto-plan
 # search — reads to decide a replica is degrading before its breaker
 # trips.  docs/serving.md documents every field.
-FLEET_SCHEMA_VERSION = 4
+FLEET_SCHEMA_VERSION = 5
 FLEET_FIELDS_V2 = frozenset(
     (
         "schema_version",
@@ -140,7 +141,14 @@ FLEET_FIELDS_V3 = FLEET_FIELDS_V2 | frozenset(("alerts",))
 # the `fleet_timeline_queue_depth` gauge — `tenants` — the per-tenant
 # stats summed across replica feeds — and `autoscale` — the attached
 # Autoscaler's state snapshot (null until serve.autoscale attaches one).
-FLEET_FIELDS = FLEET_FIELDS_V3 | frozenset(("queue_depth", "tenants", "autoscale"))
+FLEET_FIELDS_V4 = FLEET_FIELDS_V3 | frozenset(("queue_depth", "tenants", "autoscale"))
+# fleet schema v5 (additive): `ha` — the router's high-availability block
+# (null while journaling is off; else {"role", "epoch", "journal",
+# "lease", "recovery"} — the fenced leader epoch, journal append/segment
+# stats, and, after a crash recovery or standby takeover, the recovery
+# audit: pending rids reconstructed, outcomes harvested from the
+# replicas' /outcomes linger, rids re-driven from the prompt).
+FLEET_FIELDS = FLEET_FIELDS_V4 | frozenset(("ha",))
 # per-replica row of the `/fleet` feed (frozen with the outer schema)
 FLEET_REPLICA_FIELDS_V1 = frozenset(
     (
@@ -430,6 +438,9 @@ class FleetObservability:
         # serve.autoscale.Autoscaler attaches its state callable here so
         # /fleet v4 carries the control loop's view (null until attached)
         self.autoscale_provider = None
+        # FleetRouter wires its _ha_state here when a journal/lease is
+        # attached so /fleet v5 carries leadership + journal health
+        self.ha_provider = None
         self._start = time.perf_counter()
 
     # ------------------------------------------------------------ rollups
@@ -548,6 +559,8 @@ class FleetObservability:
             "autoscale": (
                 self.autoscale_provider() if self.autoscale_provider else None
             ),
+            # v5: the router HA block (null while journaling is off)
+            "ha": self.ha_provider() if self.ha_provider else None,
         }
         assert set(out) == FLEET_FIELDS  # the freeze, enforced at source
         return out

@@ -89,6 +89,7 @@ import bisect
 import collections
 import dataclasses
 import json
+import os
 import time
 import urllib.error
 import urllib.request
@@ -96,6 +97,14 @@ import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import fleettrace
+from .journal import (
+    FencedEpochError,
+    FleetJournal,
+    LeaderLease,
+    make_tag,
+    slim_outcome,
+    tag_epoch,
+)
 from .scheduler import Request, TERMINAL
 
 __all__ = [
@@ -105,6 +114,7 @@ __all__ = [
     "FleetLedger",
     "FleetRouter",
     "HttpReplicaClient",
+    "StandbyRouter",
     "request_payload",
     "request_from_payload",
 ]
@@ -528,6 +538,8 @@ class FleetRouter:
         hedge_s: Optional[float] = None,
         now_fn: Callable[[], float] = time.monotonic,
         sleep_fn: Callable[[float], None] = time.sleep,
+        journal: Optional[FleetJournal] = None,
+        lease: Optional[LeaderLease] = None,
     ):
         from ..analysis import envreg
 
@@ -562,6 +574,37 @@ class FleetRouter:
 
         self.obs = FleetObservability(self)
         self._ops = None  # router-side ops server (start_ops)
+        # ----- HA (ISSUE 20): write-ahead journal + fenced leader lease.
+        # epoch 0 == journaling off: tags stay bare counters and every
+        # pre-HA behavior (and test) is byte-identical.
+        self.journal = journal
+        self.lease = lease
+        if self.journal is None:
+            jdir = envreg.get_str("VESCALE_FLEET_JOURNAL_DIR")
+            if jdir:
+                self.journal = FleetJournal(jdir)
+        if self.lease is None:
+            lpath = envreg.get_str("VESCALE_FLEET_LEASE_PATH")
+            if lpath:
+                self.lease = LeaderLease(lpath, holder=f"router-{os.getpid()}")
+        self.epoch = 0
+        if self.lease is not None:
+            self.epoch = self.lease.acquire()
+        elif self.journal is not None:
+            # no lease: each (re)start is still a fresh generation, so a
+            # prior incarnation's stale placements can never tag-match
+            self.epoch = self.journal.last_epoch + 1
+        if self.journal is not None:
+            self.journal.attach_lease(self.lease)
+            self.journal.begin_epoch(self.epoch)
+        # journal-snapshot providers (extras the tail can't reconstruct):
+        # the Autoscaler attaches its clock snapshot here; the rollout
+        # controller mirrors its stage into rollout_state as it commits
+        self.autoscale_journal_provider: Optional[Callable[[], Dict[str, Any]]] = None
+        self.rollout_state: Optional[Dict[str, Any]] = None
+        self.recovered_autoscale_state: Optional[Dict[str, Any]] = None
+        self.recovery: Optional[Dict[str, Any]] = None  # recover_from_journal fills
+        self.obs.ha_provider = self._ha_state
 
     # ---------------------------------------------------------- lifecycle
     def add_replica(self, replica_id: str, client) -> None:
@@ -591,6 +634,7 @@ class FleetRouter:
         from .. import telemetry as _tel
 
         now = self._now()
+        polled_any = False
         for h in list(self.replicas.values()):
             due = (
                 force
@@ -599,6 +643,7 @@ class FleetRouter:
             )
             if not due:
                 continue
+            polled_any = True
             pre_state = h.breaker.state
             disposition = h.breaker.poll_disposition()
             if (
@@ -647,6 +692,18 @@ class FleetRouter:
             "fleet_healthy_replicas",
             sum(1 for h in self.replicas.values() if h.breaker.dispatchable),
         )
+        # HA housekeeping rides the real poll cadence (not every poll()
+        # CALL — _dispatch invokes poll per attempt): renew the lease,
+        # flush buffered journal records, snapshot on cadence.  A full
+        # buffer flushes regardless so an idle-poll router stays bounded.
+        if self.lease is not None and polled_any:
+            self.lease.renew()  # FencedEpochError => this leader is deposed
+        if self.journal is not None and (
+            polled_any or self.journal.buffered >= self.journal.max_buffer
+        ):
+            self.journal.flush()
+            if self.journal.should_snapshot():
+                self.journal.write_snapshot(self._journal_extras())
         # poll boundary = the router's step boundary: refresh the
         # fleet_timeline_* rollup gauges, snapshot them into the
         # time-series store, and run the alert rules over the history
@@ -776,6 +833,14 @@ class FleetRouter:
             submitted_at=now,
         )
         self.ledger.submitted(rec)
+        if self.journal is not None:
+            # wall-clock deadline: a recovered router (a different
+            # process, a different monotonic clock) re-anchors from it
+            self.journal.append("submit", {
+                "rid": req.rid,
+                "req": request_payload(req, session=session),
+                "deadline_wall": (time.time() + deadline_s) if deadline_s else None,
+            })
         _tel.count("fleet_requests_total")
         self._dispatch(rec)
         _tel.set_gauge("fleet_pending_requests", self.ledger.pending_count())
@@ -785,6 +850,33 @@ class FleetRouter:
         if rec.deadline_at is None:
             return float("inf")
         return rec.deadline_at - self._now()
+
+    def _resolve(
+        self, rec: FleetRecord, status: str, outcome: Optional[Dict[str, Any]],
+        replica_id: Optional[str], now: float,
+    ) -> bool:
+        """Journal-then-resolve: the terminal record is durable (flushed
+        through the lease fence) BEFORE the outcome is acked into the
+        ledger — a deposed leader's flush raises ``FencedEpochError``
+        here, so a stale leader can never double-resolve a rid the new
+        leader owns."""
+        if self.journal is not None and rec.pending and status in TERMINAL:
+            self.journal.append("terminal", {
+                "rid": rec.req.rid, "status": status, "replica": replica_id,
+                "outcome": slim_outcome(outcome),
+            })
+            self.journal.flush()
+        return self.ledger.resolve(rec, status, outcome, replica_id, now)
+
+    def _journal_drop(self, rec: FleetRecord, replica_id: str, why: str) -> None:
+        """A rid left a replica WITHOUT a terminal (shed spill-over,
+        failover): journaled so recovery's live_on — the set of replicas
+        whose /outcomes may legitimately hold this rid's terminal row —
+        stays exact (a stale shed row must not be harvestable)."""
+        if self.journal is not None:
+            self.journal.append(
+                "drop", {"rid": rec.req.rid, "replica": replica_id, "why": why}
+            )
 
     def _dispatch(
         self, rec: FleetRecord, exclude: Sequence[str] = (), kind: str = "dispatch",
@@ -802,7 +894,7 @@ class FleetRouter:
         backoff = self.backoff_s
         for attempt in range(max(1, self.dispatch_retries)):
             if self._remaining(rec) <= 0:
-                self.ledger.resolve(
+                self._resolve(
                     rec, "timed_out",
                     {"status": "timed_out", "tokens": [], "reason": "fleet deadline"},
                     None, self._now(),
@@ -829,7 +921,14 @@ class FleetRouter:
                 backoff = min(backoff * 2, self.backoff_max_s)
                 continue
             self._tag_counter += 1
-            tag = self._tag_counter
+            # epoch-fenced dispatch token: a deposed leader's placements
+            # carry its (older) epoch and can never tag-match a recovered
+            # router's expectations.  epoch 0 keeps the pre-HA bare tag.
+            tag = (
+                make_tag(self.epoch, self._tag_counter)
+                if self.epoch
+                else self._tag_counter
+            )
             # span tag only — skip the recompute entirely while dormant
             # (this is the hop cost the bench's <1% bar measures)
             score = (
@@ -873,6 +972,14 @@ class FleetRouter:
             h.last_dispatch_at = now
             rec.tag_by_replica[h.id] = tag
             self.ledger.dispatched(rec, h.id, now)
+            if self.journal is not None:
+                # placement barrier: the replica ACCEPTED this dispatch —
+                # journal it (and flush, so a pump-boundary crash can
+                # never re-drive an already-placed rid into a duplicate)
+                self.journal.append("dispatch", {
+                    "rid": rec.req.rid, "replica": h.id, "tag": tag, "kind": kind,
+                })
+                self.journal.flush()
             if kind != "dispatch":
                 rec.resubmissions += 1
                 self.ledger.counts["redispatched"] += 1
@@ -920,7 +1027,7 @@ class FleetRouter:
             ),
             default=0.05,
         )
-        self.ledger.resolve(
+        self._resolve(
             rec, "shed",
             {"status": "shed", "tokens": [], "reason": reason, "retry_after_s": retry},
             None, self._now(),
@@ -937,6 +1044,7 @@ class FleetRouter:
         for rec in self.ledger.pending():
             if replica_id in rec.live_on:
                 rec.live_on.remove(replica_id)
+                self._journal_drop(rec, replica_id, "failover")
                 if not rec.live_on:  # no hedge copy still running elsewhere
                     self._dispatch(rec, exclude=[replica_id], kind="failover")
 
@@ -946,7 +1054,15 @@ class FleetRouter:
         replicas that hold in-flight work, enforce fleet deadlines, place
         hedges.  Returns the number of requests still pending."""
         from .. import telemetry as _tel
+        from ..resilience import faultsim as _fs
 
+        if _fs.fires("router_kill", ctx="pump"):
+            # the ROUTER dies abruptly (the HA smoke's kill -9): no
+            # flush, no cleanup — buffered journal records are LOST by
+            # design, which is exactly what recovery must absorb
+            from ..analysis import envreg as _envreg
+
+            os._exit(int(_envreg.get_int("VESCALE_FAULTSIM_KILL_EXIT_CODE") or 29))
         self.poll()
         now = self._now()
         # ---- harvest outcomes from every replica holding live work
@@ -979,12 +1095,16 @@ class FleetRouter:
                     and expected is not None
                     and int(out_tag) != expected
                 ):
+                    if tag_epoch(int(out_tag)) != tag_epoch(expected):
+                        # epoch-fenced rejection: a DEPOSED leader's
+                        # placement landed late — visible, never consumed
+                        _tel.count("fleet_stale_epoch_outcome_total")
                     continue
                 self._on_outcome(rec, h, out)
         # ---- fleet deadline enforcement (bounds failover loops too)
         for rec in self.ledger.pending():
             if self._remaining(rec) <= 0:
-                self.ledger.resolve(
+                self._resolve(
                     rec, "timed_out",
                     {"status": "timed_out", "tokens": [], "reason": "fleet deadline"},
                     None, now,
@@ -1012,12 +1132,13 @@ class FleetRouter:
         if status == "completed" or status == "timed_out":
             # timed_out is the request's OWN deadline expiring on-replica:
             # resubmitting would break deadline semantics — it is final
-            self.ledger.resolve(rec, status, out, h.id, self._now())
+            self._resolve(rec, status, out, h.id, self._now())
         elif status == "shed":
             # replica-level backpressure: honor the hint, spill elsewhere
             self._backoff_replica(h, out.get("retry_after_s"))
             if h.id in rec.live_on:
                 rec.live_on.remove(h.id)
+                self._journal_drop(rec, h.id, "shed")
             if not rec.live_on:
                 if self._all_healthy_shedding():
                     self._fleet_shed(rec, "every healthy replica shedding")
@@ -1028,6 +1149,7 @@ class FleetRouter:
             # work comes back re-queueable — re-drive it on a peer
             if h.id in rec.live_on:
                 rec.live_on.remove(h.id)
+                self._journal_drop(rec, h.id, "preempted_requeue")
             if not rec.live_on:
                 self._dispatch(rec, exclude=[h.id], kind="redispatch")
 
@@ -1083,6 +1205,204 @@ class FleetRouter:
             self._ops.stop()
             self._ops = None
 
+    # ------------------------------------------------------------- HA
+    def _journal_extras(self) -> Dict[str, Any]:
+        """The snapshot-only state the record tail can't reconstruct:
+        ring membership + replica URLs, breaker states, the autoscaler's
+        hold/cooldown clocks (attached by the Autoscaler), and the
+        in-progress rollout stage (mirrored by RolloutController)."""
+        return {
+            "ring": list(self.ring.nodes()),
+            "replica_urls": {
+                rid: getattr(h.client, "base_url", None)
+                for rid, h in self.replicas.items()
+            },
+            "breakers": {
+                rid: h.breaker.state for rid, h in self.replicas.items()
+            },
+            "autoscale": (
+                self.autoscale_journal_provider()
+                if self.autoscale_journal_provider is not None
+                else None
+            ),
+            "rollout": self.rollout_state,
+        }
+
+    def _ha_state(self) -> Optional[Dict[str, Any]]:
+        """The ``/fleet`` v5 ``ha`` block: None while HA is off (journal
+        and lease both absent), else leadership + journal health."""
+        if self.journal is None and self.lease is None:
+            return None
+        out: Dict[str, Any] = {"role": "leader", "epoch": self.epoch}
+        if self.journal is not None:
+            out["journal"] = self.journal.stats()
+        if self.lease is not None:
+            out["lease"] = self.lease.read()
+        if self.recovery is not None:
+            out["recovery"] = dict(self.recovery)
+        return out
+
+    @classmethod
+    def recover_from_journal(
+        cls,
+        journal,
+        clients: Dict[str, Any],
+        *,
+        lease: Optional[LeaderLease] = None,
+        harvest: bool = True,
+        **router_kw,
+    ) -> "FleetRouter":
+        """Crash recovery: rebuild a router from the journal's
+        snapshot+tail, then reconcile with the live fleet.
+
+        ``journal`` is a :class:`~.journal.FleetJournal` or a directory
+        path; ``clients`` maps replica_id -> transport (the recovered
+        process re-establishes its own connections — URLs ride the
+        snapshot's ``replica_urls`` if the caller wants to rebuild them).
+
+        The sequence the ISSUE names: replay (torn tail tolerated,
+        CRC-bad records quarantined+counted) -> new epoch (lease acquire
+        when fencing, else last_epoch+1) -> rebuild pending rids with
+        their per-replica dispatch tags -> **harvest** already-finished
+        outcomes from the replicas' ``/outcomes`` linger (exact tag
+        match — idempotent: a row the dead leader already journaled
+        terminal is never consumed twice) -> **re-drive** rids that were
+        never placed from the prompt (bit-identical by decode
+        determinism).  Ends with a fresh snapshot under the new epoch;
+        ``router.recovery`` carries the audit the smoke asserts."""
+        t0 = time.perf_counter()
+        if isinstance(journal, str):
+            journal = FleetJournal(journal)
+        state = journal.state
+        fr = cls(journal=journal, lease=lease, **router_kw)
+        fr._tag_counter = int(state.get("tag_counter") or 0)
+        led = fr.ledger
+        for key, val in (state.get("counts") or {}).items():
+            if key in led.counts:
+                led.counts[key] = int(val)
+        now = fr._now()
+        wall = time.time()
+        # ---- resolved rids: terminal history (tokens included) so the
+        # ledger stays total over everything ever submitted
+        for rid_s, row in (state.get("resolved") or {}).items():
+            req = (
+                request_from_payload(row["req"])
+                if row.get("req")
+                else Request(rid=int(rid_s), prompt=(0,), max_new_tokens=1)
+            )
+            rec = FleetRecord(
+                req=req,
+                session=(row.get("req") or {}).get("session"),
+                status=row.get("status"),
+                outcome=row.get("outcome"),
+                replica=row.get("replica"),
+                failovers=int(row.get("failovers") or 0),
+                resubmissions=int(row.get("resubmissions") or 0),
+                hedged=bool(row.get("hedged")),
+                submitted_at=now,
+                resolved_at=now,
+            )
+            led.records[req.rid] = rec
+        # ---- pending rids: reconstructed WITH tags/live_on so harvest
+        # can match rows exactly and stale rows stay unconsumable
+        for rid_s, ent in (state.get("pending") or {}).items():
+            req = request_from_payload(ent["req"]) if ent.get("req") else Request(
+                rid=int(rid_s), prompt=(0,), max_new_tokens=1
+            )
+            dw = ent.get("deadline_wall")
+            rec = FleetRecord(
+                req=req,
+                session=(ent.get("req") or {}).get("session"),
+                deadline_at=(now + (float(dw) - wall)) if dw else None,
+                live_on=list(ent.get("live_on") or ()),
+                tag_by_replica={
+                    str(r): int(t) for r, t in (ent.get("tags") or {}).items()
+                },
+                attempts=[(str(r), now) for r in (ent.get("attempts") or ())],
+                resubmissions=int(ent.get("resubmissions") or 0),
+                failovers=int(ent.get("failovers") or 0),
+                hedged=bool(ent.get("hedged")),
+                submitted_at=now,
+            )
+            led.records[req.rid] = rec
+            led._pending[req.rid] = rec
+        for rid, client in clients.items():
+            fr.add_replica(rid, client)
+        extras = state.get("extras") or {}
+        # breaker states restore as-is; an OPEN breaker's cooldown clock
+        # restarts NOW (conservative: one extra probe, never a stale close)
+        for rid, bstate in (extras.get("breakers") or {}).items():
+            h = fr.replicas.get(rid)
+            if h is not None and bstate in (
+                CircuitBreaker.OPEN, CircuitBreaker.HALF_OPEN,
+            ):
+                h.breaker.state = CircuitBreaker.OPEN
+                h.breaker.opened_at = now
+        fr.recovered_autoscale_state = extras.get("autoscale")
+        fr.rollout_state = extras.get("rollout")
+        pending_at_recovery = led.pending_count()
+        harvested = redriven = 0
+        if harvest:
+            fr.poll(force=True)
+            for rec in list(led.pending()):
+                # harvest: any replica this rid is still live on may hold
+                # its terminal row in the post-drain /outcomes linger
+                for rep_id in list(rec.live_on):
+                    h = fr.replicas.get(rep_id)
+                    if h is None:
+                        rec.live_on.remove(rep_id)
+                        continue
+                    try:
+                        outs = h.client.outcomes().get("outcomes", {})
+                    except ReplicaUnreachable:
+                        continue  # breaker path fails it over on poll
+                    out = outs.get(str(rec.req.rid))
+                    if out is None or out.get("status") not in TERMINAL:
+                        continue
+                    out_tag = out.get("tag")
+                    expected = rec.tag_by_replica.get(rep_id)
+                    if (
+                        out_tag is not None
+                        and expected is not None
+                        and int(out_tag) != expected
+                    ):
+                        continue  # stale row from a prior dispatch/epoch
+                    fr._on_outcome(rec, h, out)
+                    if not rec.pending:
+                        harvested += 1
+                        break
+                # re-drive: a rid with NO live placement (its dispatch
+                # records were lost with the crash, or its replicas are
+                # gone) replays from the prompt — bit-identical tokens
+                if rec.pending and not rec.live_on:
+                    if fr._dispatch(rec, kind="failover"):
+                        redriven += 1
+        fr.recovery = {
+            "pending_at_recovery": pending_at_recovery,
+            "harvested": harvested,
+            "redriven": redriven,
+            "replayed_records": journal.replay_stats["records"],
+            "quarantined": journal.replay_stats["quarantined"],
+            "torn": journal.replay_stats["torn"],
+            "epoch": fr.epoch,
+            "takeover": False,
+        }
+        from .. import telemetry as _tel
+
+        _tel.count("fleet_recover_total")
+        fleettrace.recover_event(
+            time.perf_counter() - t0,
+            epoch=fr.epoch,
+            records=journal.replay_stats["records"],
+            quarantined=journal.replay_stats["quarantined"],
+            pending=pending_at_recovery,
+            harvested=harvested,
+            redriven=redriven,
+        )
+        # fresh-epoch baseline: the next crash replays from HERE
+        journal.write_snapshot(fr._journal_extras())
+        return fr
+
     # ---------------------------------------------------------- reporting
     def fleet_ledger_check(self) -> None:
         self.ledger.check()
@@ -1100,3 +1420,77 @@ class FleetRouter:
             for h in self.replicas.values()
         }
         return {"counts": dict(self.ledger.counts), "replicas": per_replica}
+
+
+class StandbyRouter:
+    """Warm standby: tails the journal directory, watches the leader
+    lease, and promotes itself to a full :class:`FleetRouter` (via
+    :meth:`FleetRouter.recover_from_journal`) when the lease expires.
+
+    The standby holds NO fleet state of its own between polls — the
+    journal on shared storage IS the state, so a takeover is exactly a
+    crash recovery plus an epoch bump (the lease acquire fences the old
+    leader: its next flush raises :class:`~.journal.FencedEpochError`,
+    and its already-placed dispatch tags carry the old epoch, so any
+    outcome it might still try to claim is rejected by the tag gate).
+
+    Call :meth:`poll` on a cadence faster than the lease TTL; it returns
+    ``None`` while the leader is alive and the promoted ``FleetRouter``
+    once takeover completes (subsequent calls return the same router)."""
+
+    def __init__(
+        self,
+        journal_dir: str,
+        clients: Dict[str, Any],
+        *,
+        lease: Optional[LeaderLease] = None,
+        holder: str = "standby",
+        router_kwargs: Optional[Dict[str, Any]] = None,
+        journal_kwargs: Optional[Dict[str, Any]] = None,
+    ):
+        self.journal_dir = journal_dir
+        self.clients = dict(clients)
+        self.lease = lease or LeaderLease(
+            os.path.join(journal_dir, "LEASE"), holder=holder
+        )
+        self.router_kwargs = dict(router_kwargs or {})
+        self.journal_kwargs = dict(journal_kwargs or {})
+        self.router: Optional[FleetRouter] = None
+        self.takeovers = 0
+
+    def tail(self) -> Dict[str, Any]:
+        """Cheap standby-side view: replay the journal read-only and
+        report its health (no router is built, nothing is written)."""
+        from .journal import replay_dir
+
+        state, stats = replay_dir(self.journal_dir)
+        return {
+            "epoch": state.get("epoch", 0),
+            "pending": len(state.get("pending") or ()),
+            "lease": self.lease.read(),
+            **stats,
+        }
+
+    def poll(self) -> Optional[FleetRouter]:
+        if self.router is not None:
+            return self.router
+        st = self.lease.read()
+        if st is not None and not self.lease.expired(st):
+            return None  # leader alive
+        t0 = time.perf_counter()
+        journal = FleetJournal(self.journal_dir, **self.journal_kwargs)
+        fr = FleetRouter.recover_from_journal(
+            journal, self.clients, lease=self.lease, **self.router_kwargs
+        )
+        fr.recovery["takeover"] = True
+        self.router = fr
+        self.takeovers += 1
+        from .. import telemetry as _tel
+
+        _tel.count("fleet_takeover_total")
+        fleettrace.takeover_event(
+            time.perf_counter() - t0,
+            epoch=fr.epoch,
+            reason="lease_expired" if st is not None else "no_leader",
+        )
+        return fr

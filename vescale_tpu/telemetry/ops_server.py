@@ -19,7 +19,14 @@ the run.  This module serves the replica's network surface from one
                  added (v2 added ``replica_id`` and ``accepting``).
   ``/outcomes``  the replica's terminal-outcome ledger snapshot
                  (serve/fleet.py registers it) — how the fleet router
-                 learns completions without a push channel.
+                 learns completions without a push channel.  Rows linger
+                 after drain and echo the dispatch ``tag`` (epoch-fenced
+                 since /fleet v5), which is what makes post-crash harvest
+                 by a recovered/standby router idempotent: a row the dead
+                 leader already journaled terminal, or one from a stale
+                 epoch's placement, fails the exact-tag gate and is never
+                 double-resolved (serve/journal.py, docs/serving.md
+                 router HA).
   ``/submit``    POST: one request into the replica's inbox
                  (serve/fleet.py) — the fleet router's dispatch hop.
   ``/alerts``    the alert-engine lifecycle snapshot (telemetry/alerts.py
@@ -32,6 +39,9 @@ the run.  This module serves the replica's network surface from one
                  ``serve/obs.py::FleetObservability.fleet`` on the
                  ROUTER process's own server (frozen schema
                  ``FLEET_FIELDS``, gated by ``VESCALE_FLEET_OPS_PORT``).
+                 v5 added ``ha`` — the fenced leader epoch, journal
+                 stats, and post-recovery audit a failed-over router
+                 re-announces itself with.
 
 Hardening (the fleet front-end depends on it):
 
